@@ -1,0 +1,139 @@
+"""Static model dimensions and the action-instance grid.
+
+The reference spec's abstract constants (``Server``, ``Value`` —
+/root/reference/raft.tla:11-14) are bound to finite model-value sets by the
+TLC harness (/root/reference/MCraft.tla:15-21: 3 servers, 2 values).  In the
+TPU build those bindings become *static dimensions*: every tensor shape and
+the complete action-instance grid are known at trace time, so XLA compiles
+one fixed program per (N, V, L, M) tuple.
+
+Encoding conventions (used by both the JAX kernels and the Python oracle):
+
+- servers are ``0..N-1`` (model values ``r1..rN`` interned in order);
+- values are ``1..V`` (``0`` is reserved for "empty log slot");
+- roles: ``0=Follower, 1=Candidate, 2=Leader`` (any distinct codes are
+  sound per ``ASSUME DistinctRoles`` raft.tla:494-496);
+- ``votedFor``: ``0=Nil, 1..N`` = server id + 1 (``Nil`` distinct: raft.tla:20);
+- message types: ``0=RequestVoteRequest, 1=RequestVoteResponse,
+  2=AppendEntriesRequest, 3=AppendEntriesResponse`` (distinctness:
+  raft.tla:498-503);
+- vote sets (``votesResponded``/``votesGranted`` raft.tla:56-59) are N-bit
+  bitmasks, bit ``j`` = server ``j``;
+- logs (raft.tla:48) are fixed ``[L]`` term/value lanes plus a length; slots
+  ``>= len`` MUST be zero (canonical form for fingerprinting).
+
+Message slot layout (one in-flight distinct message = one ``[MSG_WIDTH]``
+int32 row plus a count; the bag of messages raft.tla:31 is the multiset
+{row: count}).  Field 0 stores ``mtype + 1`` so an all-zero row is an
+unambiguous free slot.  Payload union (schemas raft.tla:443-475):
+
+  common:  [0]=mtype+1  [1]=msource+1  [2]=mdest+1  [3]=mterm
+  RVReq :  [4]=mlastLogTerm  [5]=mlastLogIndex
+  RVResp:  [4]=mvoteGranted  [5]=Len(mlog)  [6:6+L]=mlog terms  [6+L:6+2L]=mlog values
+  AEReq :  [4]=mprevLogIndex (SmokeInt can be -1: Smokeraft.tla:14-15, type Int
+           raft.tla:454)  [5]=mprevLogTerm  [6]=Len(mentries) (<=1:
+           raft.tla:181-183)  [7]=entry term  [8]=entry value  [9]=mcommitIndex
+  AEResp:  [4]=msuccess  [5]=mmatchIndex
+
+``mlog`` (the full log copy in RequestVoteResponse, raft.tla:259,465) forces
+the payload width to ``2 + 2L``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+# Role codes.
+FOLLOWER, CANDIDATE, LEADER = 0, 1, 2
+NIL = 0
+
+# Message-type codes.
+RVQ, RVR, AEQ, AER = 0, 1, 2, 3
+MSG_TYPE_NAMES = ("RequestVoteRequest", "RequestVoteResponse",
+                  "AppendEntriesRequest", "AppendEntriesResponse")
+
+# Action-family codes; order mirrors the Next disjunction raft.tla:421-430.
+A_RESTART = 0        # \E i : Restart(i)            raft.tla:421 -> :136
+A_TIMEOUT = 1        # \E i : Timeout(i)            raft.tla:422 -> :146
+A_REQUESTVOTE = 2    # \E i,j : RequestVote(i,j)    raft.tla:423 -> :157
+A_BECOMELEADER = 3   # \E i : BecomeLeader(i)       raft.tla:424 -> :195
+A_CLIENTREQUEST = 4  # \E i,v : ClientRequest(i,v)  raft.tla:425 -> :206
+A_ADVANCECOMMIT = 5  # \E i : AdvanceCommitIndex(i) raft.tla:426 -> :219
+A_APPENDENTRIES = 6  # \E i,j : AppendEntries(i,j)  raft.tla:427 -> :171
+A_RECEIVE = 7        # \E m : Receive(m)            raft.tla:428 -> :388
+A_DUPLICATE = 8      # \E m : DuplicateMessage(m)   raft.tla:429 -> :410
+A_DROP = 9           # \E m : DropMessage(m)        raft.tla:430 -> :415
+
+FAMILY_NAMES = ("Restart", "Timeout", "RequestVote", "BecomeLeader",
+                "ClientRequest", "AdvanceCommitIndex", "AppendEntries",
+                "Receive", "DuplicateMessage", "DropMessage")
+
+
+@dataclasses.dataclass(frozen=True)
+class RaftDims:
+    """Static shape parameters of one compiled checker instance."""
+
+    n_servers: int           # |Server|   (MCraft.tla:20-21 -> 3)
+    n_values: int            # |Value|    (MCraft.tla:15-17 -> 2)
+    max_log: int = 8         # L: log tensor capacity (>= any reachable length)
+    n_msg_slots: int = 32    # M: capacity for distinct in-flight messages
+
+    def __post_init__(self):
+        if not (1 <= self.n_servers <= 8):
+            raise ValueError("n_servers must be in 1..8 (bitmask encoding)")
+        if self.n_values < 1:
+            raise ValueError("n_values must be >= 1")
+
+    # -- derived widths ----------------------------------------------------
+    @property
+    def payload_width(self) -> int:
+        return max(6, 2 + 2 * self.max_log)
+
+    @property
+    def msg_width(self) -> int:
+        return 4 + self.payload_width
+
+    # -- action-instance grid ---------------------------------------------
+    # Per-family instance counts; the expand kernel emits exactly one
+    # candidate successor per instance with an enabled mask.  Receive yields
+    # at most one successor per message because its disjuncts are pairwise
+    # mutually exclusive (term comparisons partition on </=/>; see the
+    # guards at raft.tla:282,296,335,361,374,383).
+    @property
+    def family_sizes(self) -> tuple:
+        n, v, m = self.n_servers, self.n_values, self.n_msg_slots
+        return (n, n, n * n, n, n * v, n, n * n, m, m, m)
+
+    @property
+    def family_offsets(self) -> tuple:
+        offs, acc = [], 0
+        for s in self.family_sizes:
+            offs.append(acc)
+            acc += s
+        return tuple(offs)
+
+    @property
+    def n_instances(self) -> int:
+        return sum(self.family_sizes)
+
+    def instance_info(self, g: int) -> tuple:
+        """Decode grid index -> (family, params dict). Host-side helper for
+        trace printing/replay."""
+        n, v = self.n_servers, self.n_values
+        for fam, (off, size) in enumerate(zip(self.family_offsets,
+                                              self.family_sizes)):
+            if off <= g < off + size:
+                k = g - off
+                if fam in (A_RESTART, A_TIMEOUT, A_BECOMELEADER,
+                           A_ADVANCECOMMIT):
+                    return fam, {"i": k}
+                if fam in (A_REQUESTVOTE, A_APPENDENTRIES):
+                    return fam, {"i": k // n, "j": k % n}
+                if fam == A_CLIENTREQUEST:
+                    return fam, {"i": k // v, "v": k % v + 1}
+                return fam, {"slot": k}
+        raise IndexError(g)
+
+    def describe_instance(self, g: int) -> str:
+        fam, p = self.instance_info(g)
+        return f"{FAMILY_NAMES[fam]}({', '.join(f'{k}={v}' for k, v in p.items())})"

@@ -1,0 +1,18 @@
+"""Test harness configuration.
+
+Tests run on a virtual 8-device CPU mesh (SURVEY §4.5): the environment
+variables below MUST be set before jax initializes its backend, which is why
+they live at conftest import time.  Real-TPU execution is exercised by
+``bench.py`` / ``__graft_entry__.py``, not the unit suite.
+"""
+
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

@@ -1,0 +1,183 @@
+"""Golden tests for the pure-Python oracle, hand-derived from the spec text.
+
+Every expected fact here is derivable by reading /root/reference/raft.tla
+directly; these tests pin the oracle before it is used as the differential
+baseline for the JAX kernels.
+"""
+
+from raft_tla_tpu.models import oracle as orc
+from raft_tla_tpu.models.dims import (A_TIMEOUT, AEQ, AER, CANDIDATE,
+                                      FOLLOWER, LEADER, NIL, RVQ, RVR,
+                                      RaftDims)
+from raft_tla_tpu.models.pystate import bag_add, init_state
+
+DIMS = RaftDims(n_servers=3, n_values=2)
+
+
+def test_init_state():
+    s = init_state(DIMS)
+    assert s.current_term == (1, 1, 1)
+    assert s.role == (FOLLOWER,) * 3
+    assert s.voted_for == (NIL,) * 3
+    assert s.log == ((), (), ())
+    assert s.next_index == ((1, 1, 1),) * 3
+    assert s.match_index == ((0, 0, 0),) * 3
+    assert s.messages == frozenset()
+
+
+def test_successors_of_init():
+    # From Init only Restart (self-loop) and Timeout are enabled:
+    # no candidates => no RequestVote/BecomeLeader; no leaders => no
+    # ClientRequest/AdvanceCommitIndex/AppendEntries; empty bag => no
+    # message actions.  AdvanceCommitIndex/Restart require no guard beyond
+    # role, so Restart contributes 3 self-loops.
+    s = init_state(DIMS)
+    succ = orc.successors(s, DIMS)
+    assert len(succ) == 6  # 3x Restart + 3x Timeout
+    sset = orc.successor_set(s, DIMS)
+    assert s in sset       # Restart(i) on Init reproduces Init exactly
+    assert len(sset) == 4  # Init + three Timeout(i) variants
+    for (fam, params), t in succ:
+        if fam == A_TIMEOUT:
+            (i,) = params
+            assert t.current_term[i] == 2 and t.role[i] == CANDIDATE
+
+
+def test_bfs_level1():
+    res = orc.bfs([init_state(DIMS)], DIMS, max_levels=1)
+    assert res.distinct_states == 4
+    assert res.levels[0] == 1 and res.levels[1] == 3
+
+
+def test_candidate_flow_to_leader():
+    """Drive one server through the full election pipeline by hand."""
+    dims = DIMS
+    s = init_state(dims)
+    s = orc.timeout(s, dims, 0)
+    assert s.role[0] == CANDIDATE and s.current_term[0] == 2
+
+    # Candidate asks itself for a vote (i = j allowed, raft.tla:150).
+    s = orc.request_vote(s, dims, 0, 0)
+    (m, c), = s.messages
+    assert m == (RVQ, 0, 0, 2, 0, 0) and c == 1
+
+    # Receiving its own request: mterm (2) > currentTerm? no, equal; grants.
+    s2 = orc.receive(s, dims, m)
+    assert s2.voted_for[0] == 1  # voted for server 0 (encoded 0+1)
+    (resp, c2), = s2.messages
+    assert resp == (RVR, 0, 0, 2, 1, ()) and c2 == 1
+
+    # Tally the vote.
+    s3 = orc.receive(s2, dims, resp)
+    assert s3.votes_responded[0] == 0b001
+    assert s3.votes_granted[0] == 0b001
+    assert s3.messages == frozenset()
+
+    # One vote of three is not a quorum.
+    assert orc.become_leader(s3, dims, 0) is None
+    # Fake a second grant.
+    s4 = s3.replace(votes_granted=(0b011, 0, 0))
+    s5 = orc.become_leader(s4, dims, 0)
+    assert s5.role[0] == LEADER
+    assert s5.next_index[0] == (1, 1, 1)  # Len(log)+1 with empty log
+
+
+def test_update_term_leaves_message_in_flight():
+    """UpdateTerm (raft.tla:373-379) must not consume the message (:378)."""
+    dims = DIMS
+    s = init_state(dims)
+    m = (RVQ, 1, 0, 5, 0, 0)  # term 5 > currentTerm 1
+    s = s.replace(messages=bag_add(s.messages, m))
+    t = orc.receive(s, dims, m)
+    assert t.current_term[0] == 5 and t.role[0] == FOLLOWER
+    assert t.messages == s.messages  # still in flight
+    # Re-processing in the successor now takes the handler branch.
+    t2 = orc.receive(t, dims, m)
+    assert t2.voted_for[0] == 2  # granted to server 1
+    assert (m, 1) not in t2.messages
+
+
+def test_already_done_hidden_guard():
+    """AppendEntriesAlreadyDone's :317 bug => enabled only when
+    m.mcommitIndex = commitIndex[i]."""
+    dims = DIMS
+    s = init_state(dims)
+    # Follower 0 at term 1 with empty log; heartbeat with prev=0, no entries.
+    hb_ok = (AEQ, 1, 0, 1, 0, 0, (), 0)    # mcommitIndex = 0 = commitIndex[0]
+    hb_bad = (AEQ, 1, 0, 1, 0, 0, (), 1)   # mcommitIndex = 1 != 0
+    s_ok = s.replace(messages=bag_add(s.messages, hb_ok))
+    t = orc.receive(s_ok, dims, hb_ok)
+    assert t is not None
+    (resp, _), = t.messages
+    assert resp == (AER, 0, 1, 1, 1, 0)    # success, matchIndex=0
+    s_bad = s.replace(messages=bag_add(s.messages, hb_bad))
+    assert orc.receive(s_bad, dims, hb_bad) is None
+
+
+def test_conflict_truncates_one_entry():
+    """ConflictAppendEntriesRequest (raft.tla:319-325) drops exactly one
+    trailing entry regardless of the conflict position."""
+    dims = DIMS
+    s = init_state(dims)
+    log0 = ((1, 1), (2, 1), (2, 2))
+    s = s.replace(log=(log0, (), ()),
+                  current_term=(3, 3, 3))
+    # Conflict at index 1 (prev=0 always logOk): entry term 3 != 1.
+    m = (AEQ, 1, 0, 3, 0, 0, ((3, 2),), 0)
+    s = s.replace(messages=bag_add(s.messages, m))
+    t = orc.receive(s, dims, m)
+    assert t.log[0] == ((1, 1), (2, 1))    # only the LAST entry dropped
+    assert t.messages == s.messages        # no reply, message in flight
+
+
+def test_duplicate_and_drop():
+    dims = DIMS
+    s = init_state(dims)
+    m = (RVQ, 0, 1, 1, 0, 0)
+    s = s.replace(messages=bag_add(s.messages, m))
+    d = orc.duplicate_message(s, m)
+    assert dict(d.messages)[m] == 2
+    d2 = orc.drop_message(d, m)
+    assert dict(d2.messages)[m] == 1
+    d3 = orc.drop_message(d2, m)
+    assert d3.messages == frozenset()
+
+
+def test_advance_commit_requires_current_term_entry():
+    """The §5.4.2 rule (raft.tla:229-230): only entries of the leader's own
+    term are committed directly."""
+    dims = DIMS
+    s = init_state(dims)
+    s = s.replace(role=(LEADER, FOLLOWER, FOLLOWER),
+                  current_term=(2, 2, 2),
+                  log=(((1, 1),), ((1, 1),), ((1, 1),)),
+                  match_index=((0, 1, 1), (0, 0, 0), (0, 0, 0)))
+    # Quorum agrees on index 1, but its term (1) != currentTerm (2): no move.
+    t = orc.advance_commit_index(s, dims, 0)
+    assert t.commit_index[0] == 0
+    # Same with an own-term entry: commits.
+    s2 = s.replace(log=(((2, 1),), ((2, 1),), ((2, 1),)))
+    t2 = orc.advance_commit_index(s2, dims, 0)
+    assert t2.commit_index[0] == 1
+
+
+def test_bounded_bfs_is_finite_and_stable():
+    """A tightly constrained space must terminate; the count is pinned as a
+    regression oracle for the JAX engine (value observed from this oracle,
+    then cross-checked by the independent JAX BFS in test_engine)."""
+    dims = DIMS
+
+    def constraint(t, d):
+        return (max(t.current_term) <= 2
+                and max(len(l) for l in t.log) <= 1
+                and all(c <= 1 for _m, c in t.messages))
+
+    res = orc.bfs([init_state(dims)], dims, constraint=constraint,
+                  check_deadlock=False, max_levels=4)
+    assert res.invariant_violation is None
+    assert res.distinct_states > 100
+    # Determinism: same run twice gives identical counts.
+    res2 = orc.bfs([init_state(dims)], dims, constraint=constraint,
+                   check_deadlock=False, max_levels=4)
+    assert (res.distinct_states, res.diameter) == (res2.distinct_states,
+                                                   res2.diameter)
