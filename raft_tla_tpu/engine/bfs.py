@@ -1,0 +1,378 @@
+"""Level-synchronous BFS — TLC's exhaustive mode as a data-parallel device loop.
+
+The classical TLC loop (one state at a time: enumerate actions, fingerprint,
+probe the FPSet, enqueue — SURVEY §1 L6) becomes a batched pipeline compiled
+to one XLA program per step:
+
+    slice B states off the current-level queue
+      -> vmap(expand): all G action instances of all B states   [B,G]
+      -> vmap(fingerprint) over the B*G candidates
+      -> sort-based in-batch dedup (two-key lax.sort)
+      -> binary-search probe of the sorted FPSet
+      -> merge new fingerprints; scatter new+constraint-passing states
+         into the next-level queue
+      -> invariant ids, deadlock mask, violation/overflow reporting
+
+Everything device-resident: the two level queues (flat int32 state rows),
+the FPSet, and all masks.  The host loop only advances offsets, swaps queues
+between levels, reads back a handful of scalars per batch, and appends
+(fingerprint -> parent fingerprint, action id) records to the trace store —
+exactly the host/device split the SURVEY's north star prescribes.
+
+TLC-semantics notes:
+- constraint-violating states are counted distinct and invariant-checked but
+  not enqueued (CONSTRAINT behavior; SURVEY §2.4 R9);
+- a state with no successors at all is a deadlock (reported unless
+  ``check_deadlock=False``, Smokeraft.cfg:48);
+- the run stops at the first invariant violation, like TLC; counterexamples
+  are reconstructed by fingerprint walk-back plus *kernel replay* (the trace
+  stores (parent fp, action instance id); re-running the expand kernel on the
+  replayed parent yields each next state bit-exactly);
+- ``generated`` counts every enabled successor evaluation (TLC's "states
+  generated"), ``distinct`` counts FPSet insertions.
+
+Budgets (``max_seconds``/``max_diameter``) reproduce the Smokeraft StopAfter
+control channel (TLCGet("duration")/TLCGet("diameter") — Smokeraft.tla:88-92)
+at batch granularity.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Callable, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..models.dims import RaftDims
+from ..models.actions import build_expand
+from ..models.pystate import PyState
+from ..models.schema import (StateBatch, decode_state, encode_state,
+                             flatten_state, state_width, unflatten_state)
+from ..ops import fpset
+from ..ops.fingerprint import build_fingerprint
+
+_I32 = jnp.int32
+
+
+@dataclasses.dataclass
+class EngineConfig:
+    batch: int = 256             # states expanded per device step
+    queue_capacity: int = 1 << 16
+    seen_capacity: int = 1 << 18
+    check_deadlock: bool = True
+    record_trace: bool = True
+    max_seconds: Optional[float] = None   # StopAfter duration budget
+    max_diameter: Optional[int] = None    # StopAfter diameter budget
+
+
+@dataclasses.dataclass
+class Violation:
+    invariant: str
+    state: PyState
+    fingerprint: int
+
+
+@dataclasses.dataclass
+class EngineResult:
+    distinct: int = 0
+    generated: int = 0
+    diameter: int = 0
+    levels: List[int] = dataclasses.field(default_factory=list)
+    violation: Optional[Violation] = None
+    deadlock: Optional[PyState] = None
+    stop_reason: str = "exhausted"
+    wall_seconds: float = 0.0
+
+    @property
+    def states_per_second(self) -> float:
+        return self.distinct / self.wall_seconds if self.wall_seconds else 0.0
+
+
+class TraceStore:
+    """fp64 -> (parent fp64, action instance id); action -1 marks roots.
+    Python-dict round-1 implementation (native C++ store arrives with M5)."""
+
+    def __init__(self):
+        self._d: Dict[int, Tuple[int, int]] = {}
+        self.roots: Dict[int, PyState] = {}
+
+    def __len__(self):
+        return len(self._d)
+
+    def add_batch(self, fps, parent_fps, actions):
+        d = self._d
+        for f, p, g in zip(fps.tolist(), parent_fps.tolist(),
+                           actions.tolist()):
+            if f not in d:
+                d[f] = (p, g)
+
+    def chain(self, fp: int) -> List[Tuple[int, int]]:
+        """Walk back to a root; returns [(fp, action_into_fp)] root-first."""
+        out = []
+        seen = set()
+        while fp in self._d and fp not in seen:
+            seen.add(fp)
+            p, g = self._d[fp]
+            out.append((fp, g))
+            if g < 0:
+                break
+            fp = p
+        return list(reversed(out))
+
+
+class BFSEngine:
+    """Exhaustive checker for one compiled (dims, invariants, constraint)."""
+
+    def __init__(self, dims: RaftDims,
+                 invariants: Optional[Dict[str, Callable]] = None,
+                 constraint: Optional[Callable] = None,
+                 config: Optional[EngineConfig] = None):
+        self.dims = dims
+        self.config = config or EngineConfig()
+        cfg = self.config
+        self.inv_names = list((invariants or {}).keys())
+        inv_fns = list((invariants or {}).values())
+        expand = build_expand(dims)
+        fingerprint = build_fingerprint(dims)
+        sw = state_width(dims)
+        B, G = cfg.batch, dims.n_instances
+        # Queue offsets advance in whole batches; capacity must be a
+        # multiple of batch so dynamic_slice never clamps (which would
+        # silently shift the window off the intended rows).  Rounded copy
+        # kept on self — the caller's config is not mutated.
+        Q = -(-cfg.queue_capacity // B) * B
+        self._sw, self._B, self._G, self._Q = sw, B, G, Q
+
+        def absorb(crows, cands, en, parent_hi, parent_lo, actions,
+                   qnext, next_count, seen):
+            """Shared tail: dedup candidates against batch+FPSet, merge,
+            enqueue, report.  ``crows`` [K,SW] flat rows, ``cands`` the
+            matching StateBatch pytree, ``en`` [K] validity."""
+            k = crows.shape[0]
+            fph, fpl = jax.vmap(fingerprint)(cands)
+            (sh, sl), order, first = fpset.dedup_batch(fph, fpl, en)
+            in_seen = fpset.contains(seen, sh, sl)
+            new = first & ~in_seen
+            seen = fpset.merge(seen, sh, sl, new)
+            n_new = jnp.sum(new, dtype=_I32)
+
+            if inv_fns:
+                def inv_id(st: StateBatch):
+                    out = jnp.int32(-1)
+                    for q in range(len(inv_fns) - 1, -1, -1):
+                        out = jnp.where(inv_fns[q](st), out, jnp.int32(q))
+                    return out
+                inv = jax.vmap(inv_id)(cands)[order]
+            else:
+                inv = jnp.full((k,), -1, _I32)
+            viol = new & (inv >= 0)
+            viol_any = jnp.any(viol)
+            vpos = jnp.argmax(viol)
+
+            if constraint is not None:
+                cons_ok = jax.vmap(constraint)(cands)[order]
+            else:
+                cons_ok = jnp.ones((k,), bool)
+            enq = new & cons_ok
+            crows_s = crows[order]
+            pos = next_count + jnp.cumsum(enq.astype(_I32)) - 1
+            pos = jnp.where(enq, pos, Q)
+            qnext = qnext.at[pos].set(crows_s, mode="drop")
+            next_count = next_count + jnp.sum(enq, dtype=_I32)
+
+            # Compacted trace records for the n_new fresh states.
+            tpos = jnp.where(new, jnp.cumsum(new.astype(_I32)) - 1, k)
+
+            def compact(x):
+                return jnp.zeros((k,), x.dtype).at[tpos].set(x, mode="drop")
+
+            tr = (compact(sh), compact(sl),
+                  compact(parent_hi[order]), compact(parent_lo[order]),
+                  compact(actions[order]))
+            vinfo = (viol_any, inv[vpos], crows_s[vpos], sh[vpos], sl[vpos])
+            return qnext, next_count, seen, n_new, tr, vinfo
+
+        def step(qcur, cur_count, offset, qnext, next_count, seen):
+            rows = jax.lax.dynamic_slice_in_dim(qcur, offset, B, axis=0)
+            valid = (offset + jnp.arange(B, dtype=_I32)) < cur_count
+            states = jax.vmap(unflatten_state, (0, None))(rows, dims)
+            cands, en, ovf = jax.vmap(expand)(states)
+            en = en & valid[:, None]
+            ovf = ovf & valid[:, None]
+            dead = valid & ~jnp.any(en, axis=1) & ~jnp.any(ovf, axis=1)
+            dead_any = jnp.any(dead)
+            drow = rows[jnp.argmax(dead)]
+
+            cflat = jax.tree.map(
+                lambda a: a.reshape((B * G,) + a.shape[2:]), cands)
+            crows = jax.vmap(flatten_state, (0, None))(cflat, dims)
+            php, plp = jax.vmap(fingerprint)(states)       # parent fps [B]
+            k_idx = jnp.arange(B * G, dtype=_I32)
+            parent_hi = php[k_idx // G]
+            parent_lo = plp[k_idx // G]
+            actions = k_idx % G
+
+            qnext, next_count, seen, n_new, tr, vinfo = absorb(
+                crows, cflat, en.reshape(-1), parent_hi, parent_lo, actions,
+                qnext, next_count, seen)
+            stats = (n_new, jnp.sum(en, dtype=_I32),
+                     jnp.sum(ovf, dtype=_I32), dead_any)
+            return qnext, next_count, seen, stats, tr, vinfo, drow
+
+        def ingest(rows, valid, qnext, next_count, seen):
+            states = jax.vmap(unflatten_state, (0, None))(rows, dims)
+            sent = jnp.zeros(rows.shape[:1], jnp.uint32)
+            acts = jnp.full(rows.shape[:1], -1, _I32)
+            return absorb(rows, states, valid, sent, sent, acts,
+                          qnext, next_count, seen)
+
+        def fp_rows(rows):
+            return jax.vmap(fingerprint)(
+                jax.vmap(unflatten_state, (0, None))(rows, dims))
+
+        self._step = jax.jit(step, donate_argnums=(3, 5))
+        self._ingest = jax.jit(ingest, donate_argnums=(2, 4))
+        self._fp_rows = jax.jit(fp_rows)
+        self._expand1 = jax.jit(expand)
+
+    # ------------------------------------------------------------------
+    def run(self, init_states: List[PyState]) -> EngineResult:
+        dims, cfg = self.dims, self.config
+        sw, B, Q = self._sw, self._B, self._Q
+        res = EngineResult()
+        trace = TraceStore()
+        self.trace = trace
+        t0 = time.time()
+
+        qcur = jnp.zeros((Q, sw), _I32)
+        qnext = jnp.zeros((Q, sw), _I32)
+        seen = fpset.empty(cfg.seen_capacity)
+        next_count = jnp.int32(0)
+
+        # Ingest initial states in B-sized chunks; register trace roots.
+        rows_np = np.stack([
+            flatten_state(encode_state(s, dims), dims) for s in init_states])
+        if cfg.record_trace:
+            rhi, rlo = (np.asarray(x) for x in
+                        self._fp_rows(jnp.asarray(rows_np)))
+            for idx, s in enumerate(init_states):
+                fp = (int(rhi[idx]) << 32) | int(rlo[idx])
+                trace.roots.setdefault(fp, s)
+        for base in range(0, len(rows_np), B):
+            chunk = rows_np[base:base + B]
+            pad = np.zeros((B - len(chunk), sw), np.int32)
+            valid = np.arange(B) < len(chunk)
+            qnext, next_count, seen, n_new, tr, vinfo = self._ingest(
+                jnp.asarray(np.concatenate([chunk, pad])),
+                jnp.asarray(valid), qnext, next_count, seen)
+            res.distinct += int(n_new)
+            self._record(trace, tr, int(n_new))
+            if int(next_count) > Q:
+                raise RuntimeError("queue capacity exceeded by initial states")
+            if int(seen.size) > cfg.seen_capacity:
+                raise RuntimeError("seen-set capacity exceeded")
+            if self._check_violation(res, vinfo):
+                break
+
+        # levels[] counts enqueued (constraint-passing) states per level,
+        # mirroring the oracle's frontier sizes.
+        res.levels.append(int(next_count))
+        qcur, qnext = qnext, qcur
+        cur_count = int(next_count)
+        next_count = jnp.int32(0)
+
+        while cur_count > 0 and res.violation is None \
+                and res.stop_reason == "exhausted":
+            if cfg.max_diameter is not None \
+                    and res.diameter >= cfg.max_diameter:
+                res.stop_reason = "diameter_budget"
+                break
+            offset = 0
+            while offset < cur_count:
+                qnext, next_count, seen, stats, tr, vinfo, drow = self._step(
+                    qcur, jnp.int32(cur_count), jnp.int32(offset),
+                    qnext, next_count, seen)
+                n_new, n_gen = int(stats[0]), int(stats[1])
+                n_ovf, dead_any = int(stats[2]), bool(stats[3])
+                if n_ovf:
+                    raise RuntimeError(
+                        f"{n_ovf} successors exceeded fixed-width capacity "
+                        f"(max_log={dims.max_log}, n_msg_slots="
+                        f"{dims.n_msg_slots}); rerun with larger capacities")
+                res.distinct += n_new
+                res.generated += n_gen
+                self._record(trace, tr, n_new)
+                if int(seen.size) > cfg.seen_capacity:
+                    raise RuntimeError("seen-set capacity exceeded")
+                if int(next_count) > Q:
+                    raise RuntimeError("queue capacity exceeded")
+                if self._check_violation(res, vinfo):
+                    break
+                if dead_any and cfg.check_deadlock:
+                    res.deadlock = decode_state(
+                        unflatten_state(np.asarray(drow), dims), dims)
+                    res.stop_reason = "deadlock"
+                    break
+                offset += B
+                if (cfg.max_seconds is not None
+                        and time.time() - t0 > cfg.max_seconds):
+                    res.stop_reason = "duration_budget"
+                    break
+            if res.stop_reason != "exhausted" or res.violation is not None:
+                break  # aborted mid-level: diameter counts completed levels
+            res.diameter += 1
+            res.levels.append(int(next_count))
+            qcur, qnext = qnext, qcur
+            cur_count = int(next_count)
+            next_count = jnp.int32(0)
+
+        res.wall_seconds = time.time() - t0
+        return res
+
+    # ------------------------------------------------------------------
+    def replay(self, fp: int) -> List[Tuple[int, PyState]]:
+        """Counterexample reconstruction: walk the trace back to a root,
+        then re-run the expand kernel forward along the recorded action
+        ids — returns [(action_id, state)] root-first (root action = -1)."""
+        chain = self.trace.chain(fp)
+        if not chain:
+            raise KeyError(f"fingerprint {fp:#x} not in trace")
+        root_fp, g0 = chain[0]
+        if g0 >= 0:
+            raise KeyError("trace chain does not reach a root")
+        state = self.trace.roots[root_fp]
+        out = [(-1, state)]
+        for _fp, g in chain[1:]:
+            st = encode_state(state, self.dims)
+            cands, en, _ovf = self._expand1(st)
+            if not bool(np.asarray(en)[g]):
+                raise RuntimeError(f"replay divergence at action {g}")
+            row = jax.tree.map(lambda a: np.asarray(a)[g], cands)
+            state = decode_state(StateBatch(*row), self.dims)
+            out.append((g, state))
+        return out
+
+    # ------------------------------------------------------------------
+    def _record(self, trace, tr, n_new):
+        if n_new == 0 or not self.config.record_trace:
+            return
+        sh, sl, ph, pl, ac = (np.asarray(x[:n_new]) for x in tr)
+        fps = (sh.astype(np.uint64) << np.uint64(32)) | sl.astype(np.uint64)
+        parents = (ph.astype(np.uint64) << np.uint64(32)) \
+            | pl.astype(np.uint64)
+        trace.add_batch(fps, parents, ac)
+
+    def _check_violation(self, res, vinfo) -> bool:
+        viol_any, vinv, vrow, vhi, vlo = vinfo
+        if not bool(viol_any):
+            return False
+        st = decode_state(unflatten_state(np.asarray(vrow), self.dims),
+                          self.dims)
+        fp = (int(vhi) << 32) | int(vlo)
+        name = self.inv_names[int(vinv)]
+        res.violation = Violation(invariant=name, state=st, fingerprint=fp)
+        res.stop_reason = "violation"
+        return True

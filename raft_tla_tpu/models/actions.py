@@ -1,0 +1,345 @@
+"""Vectorized successor kernels — the ``Next`` relation compiled for TPU.
+
+Each disjunct of ``Next`` (/root/reference/raft.tla:421-430) becomes a
+branch-free JAX function ``(state, params) -> (enabled, overflow, state')``
+operating on one ``StateBatch`` (no batch axis).  ``build_expand`` statically
+unrolls the full action-instance grid (``dims.family_sizes``) with ``vmap``
+over the parameter arrays, and the engine vmaps the result over the frontier
+axis — so one XLA program evaluates every action of every frontier state as
+pure tensor arithmetic on the MXU/VPU, with no data-dependent control flow.
+
+Semantics are transcribed from the spec with the same faithfulness notes as
+``oracle.py`` (hidden AppendEntriesAlreadyDone guard raft.tla:309+:317,
+UpdateTerm leaving the message in flight :378, single-entry truncation
+:323-324).  The mutual exclusivity of the ``Receive`` disjuncts (term
+comparisons partition </=/>, role guards partition F/C, the three Accept
+sub-cases are disjoint) lets ``Receive`` compile to a single ``jnp.where``
+cascade emitting at most one successor per message slot.
+
+``overflow`` reports states the fixed-width encoding cannot represent (log
+beyond capacity L, more than M distinct messages).  The engine surfaces any
+overflow as a hard error so a run can be repeated with larger capacities —
+results are never silently truncated.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .dims import (AEQ, AER, CANDIDATE, FOLLOWER, LEADER, NIL, RVQ, RVR,
+                   RaftDims)
+from .schema import StateBatch
+
+_TRUE = jnp.bool_(True)
+_FALSE = jnp.bool_(False)
+
+
+def _sel(cond, then_tree, else_tree):
+    """Tree-wide where on a scalar bool."""
+    return jax.tree.map(lambda a, b: jnp.where(cond, a, b), then_tree,
+                        else_tree)
+
+
+def build_expand(dims: RaftDims):
+    """Returns ``expand(state) -> (cands, enabled, overflow)`` where
+    ``cands`` stacks ``dims.n_instances`` candidate successors."""
+    N, V, L, M, W = (dims.n_servers, dims.n_values, dims.max_log,
+                     dims.n_msg_slots, dims.msg_width)
+    i32 = jnp.int32
+
+    # -- helpers ----------------------------------------------------------
+    def last_term(st: StateBatch, i):
+        """LastTerm(log[i]) — raft.tla:84."""
+        ln = st.log_len[i]
+        return jnp.where(ln > 0, st.log_term[i, jnp.clip(ln - 1, 0, L - 1)], 0)
+
+    def bag_send(st: StateBatch, mvec):
+        """Send(m) — raft.tla:95: bag count +1, allocating a slot if new.
+        Returns (state', ok); ok=False means slot overflow."""
+        eq = jnp.all(st.msg == mvec[None, :], axis=1) & (st.msg_cnt > 0)
+        has_eq = jnp.any(eq)
+        free = st.msg_cnt == 0
+        ok = has_eq | jnp.any(free)
+        idx = jnp.where(has_eq, jnp.argmax(eq), jnp.argmax(free))
+        row = jnp.where(has_eq | ~ok, st.msg[idx], mvec)
+        return st._replace(
+            msg=st.msg.at[idx].set(row),
+            msg_cnt=st.msg_cnt.at[idx].add(jnp.where(ok, 1, 0))), ok
+
+    def bag_discard_slot(st: StateBatch, s):
+        """Discard one copy of the message in slot s — raft.tla:99.  Zeroes
+        the row when the count hits 0 (canonical free slot)."""
+        new_cnt = st.msg_cnt.at[s].add(-1)
+        row = jnp.where(new_cnt[s] > 0, st.msg[s], jnp.zeros((W,), i32))
+        return st._replace(msg=st.msg.at[s].set(row), msg_cnt=new_cnt)
+
+    def reply_slot(st: StateBatch, resp, s):
+        """Reply(resp, m@slot s) — raft.tla:102-103 (atomic discard+send)."""
+        return bag_send(bag_discard_slot(st, s), resp)
+
+    def base_msg(mtype, src, dst, mterm):
+        m = jnp.zeros((W,), i32)
+        return m.at[0].set(mtype + 1).at[1].set(src + 1).at[2].set(dst + 1) \
+                .at[3].set(mterm)
+
+    # -- spontaneous server actions (raft.tla:136-236) --------------------
+    def restart(st: StateBatch, i):
+        """Restart(i) — raft.tla:136-143."""
+        new = st._replace(
+            role=st.role.at[i].set(FOLLOWER),
+            votes_resp=st.votes_resp.at[i].set(0),
+            votes_gran=st.votes_gran.at[i].set(0),
+            next_idx=st.next_idx.at[i].set(jnp.ones((N,), i32)),
+            match_idx=st.match_idx.at[i].set(jnp.zeros((N,), i32)),
+            commit=st.commit.at[i].set(0))
+        return _TRUE, _FALSE, new
+
+    def timeout(st: StateBatch, i):
+        """Timeout(i) — raft.tla:146-154 (no self-vote)."""
+        en = (st.role[i] == FOLLOWER) | (st.role[i] == CANDIDATE)
+        new = st._replace(
+            role=st.role.at[i].set(CANDIDATE),
+            term=st.term.at[i].add(1),
+            voted_for=st.voted_for.at[i].set(NIL),
+            votes_resp=st.votes_resp.at[i].set(0),
+            votes_gran=st.votes_gran.at[i].set(0))
+        return en, _FALSE, new
+
+    def request_vote(st: StateBatch, i, j):
+        """RequestVote(i, j) — raft.tla:157-166 (i = j allowed)."""
+        en = (st.role[i] == CANDIDATE) & (((st.votes_resp[i] >> j) & 1) == 0)
+        m = base_msg(RVQ, i, j, st.term[i]) \
+            .at[4].set(last_term(st, i)).at[5].set(st.log_len[i])
+        new, ok = bag_send(st, m)
+        return en & ok, en & ~ok, new
+
+    def append_entries(st: StateBatch, i, j):
+        """AppendEntries(i, j) — raft.tla:171-192 (<= 1 entry)."""
+        en = (i != j) & (st.role[i] == LEADER)
+        ln = st.log_len[i]
+        ni = st.next_idx[i, j]
+        prev = ni - 1
+        prev_term = jnp.where((prev > 0) & (prev <= ln),
+                              st.log_term[i, jnp.clip(prev - 1, 0, L - 1)], 0)
+        last_entry = jnp.minimum(ln, ni)                      # :182
+        n_ent = (ln >= ni).astype(i32)                        # SubSeq :183
+        eterm = jnp.where(n_ent > 0,
+                          st.log_term[i, jnp.clip(ni - 1, 0, L - 1)], 0)
+        eval_ = jnp.where(n_ent > 0,
+                          st.log_val[i, jnp.clip(ni - 1, 0, L - 1)], 0)
+        m = base_msg(AEQ, i, j, st.term[i]) \
+            .at[4].set(prev).at[5].set(prev_term).at[6].set(n_ent) \
+            .at[7].set(eterm).at[8].set(eval_) \
+            .at[9].set(jnp.minimum(st.commit[i], last_entry))  # :189
+        new, ok = bag_send(st, m)
+        return en & ok, en & ~ok, new
+
+    def become_leader(st: StateBatch, i):
+        """BecomeLeader(i) — raft.tla:195-203; quorum = simple majority :81."""
+        votes = jax.lax.population_count(st.votes_gran[i])
+        en = (st.role[i] == CANDIDATE) & (2 * votes > N)
+        new = st._replace(
+            role=st.role.at[i].set(LEADER),
+            next_idx=st.next_idx.at[i].set(
+                jnp.broadcast_to(st.log_len[i] + 1, (N,)).astype(i32)),
+            match_idx=st.match_idx.at[i].set(jnp.zeros((N,), i32)))
+        return en, _FALSE, new
+
+    def client_request(st: StateBatch, i, v):
+        """ClientRequest(i, v) — raft.tla:206-213."""
+        ln = st.log_len[i]
+        is_leader = st.role[i] == LEADER
+        fits = ln < L
+        k = jnp.clip(ln, 0, L - 1)
+        new = st._replace(
+            log_term=st.log_term.at[i, k].set(st.term[i]),
+            log_val=st.log_val.at[i, k].set(v),
+            log_len=st.log_len.at[i].add(1))
+        return is_leader & fits, is_leader & ~fits, new
+
+    def advance_commit(st: StateBatch, i):
+        """AdvanceCommitIndex(i) — raft.tla:219-236 incl. the §5.4.2
+        own-term rule (:229-230)."""
+        en = st.role[i] == LEADER
+        idxs = jnp.arange(1, L + 1, dtype=i32)                      # [L]
+        # Agree(index) == {i} \cup {k : matchIndex[i][k] >= index}  :222-223
+        agree_cnt = jnp.sum(
+            (st.match_idx[i][None, :] >= idxs[:, None])
+            | (jnp.arange(N)[None, :] == i), axis=1)                # [L]
+        ok = (2 * agree_cnt > N) & (idxs <= st.log_len[i])          # :225-226
+        any_ok = jnp.any(ok)
+        max_agree = jnp.max(jnp.where(ok, idxs, 0))                 # Max :232
+        own_term = st.log_term[i, jnp.clip(max_agree - 1, 0, L - 1)] \
+            == st.term[i]
+        new_commit = jnp.where(any_ok & own_term, max_agree, st.commit[i])
+        return en, _FALSE, st._replace(commit=st.commit.at[i].set(new_commit))
+
+    # -- Receive(m) (raft.tla:388-403) ------------------------------------
+    def receive(st: StateBatch, s):
+        """Receive of the message in slot s: a where-cascade over the
+        pairwise-exclusive disjuncts; at most one fires."""
+        mvec = st.msg[s]
+        occ = st.msg_cnt[s] > 0
+        mtype = mvec[0] - 1
+        # i = mdest, j = msource (raft.tla:389-390); clipped so gathers and
+        # shifts stay in range on free (all-zero) rows — every use is gated
+        # on occupancy, so the clip never changes an enabled branch.
+        j = jnp.clip(mvec[1] - 1, 0, N - 1)
+        i = jnp.clip(mvec[2] - 1, 0, N - 1)
+        mterm = mvec[3]
+        t_i = st.term[i]
+        role_i = st.role[i]
+        ln = st.log_len[i]
+
+        # UpdateTerm — raft.tla:373-379; message left in flight (:378).
+        en_ut = occ & (mterm > t_i)
+        st_ut = st._replace(term=st.term.at[i].set(mterm),
+                            role=st.role.at[i].set(FOLLOWER),
+                            voted_for=st.voted_for.at[i].set(NIL))
+
+        le = occ & (mterm <= t_i)
+
+        # HandleRequestVoteRequest — raft.tla:244-263.
+        lt = last_term(st, i)
+        rvq_logok = (mvec[4] > lt) | ((mvec[4] == lt) & (mvec[5] >= ln))
+        grant = (mterm == t_i) & rvq_logok & \
+            ((st.voted_for[i] == NIL) | (st.voted_for[i] == j + 1))
+        rvr_resp = base_msg(RVR, i, j, t_i) \
+            .at[4].set(grant.astype(i32)).at[5].set(ln)
+        # mlog carries the full log copy (:257-259, :465).
+        rvr_resp = jax.lax.dynamic_update_slice(rvr_resp, st.log_term[i], (6,))
+        rvr_resp = jax.lax.dynamic_update_slice(rvr_resp, st.log_val[i],
+                                                (6 + L,))
+        st_rvq = st._replace(
+            voted_for=jnp.where(grant,
+                                st.voted_for.at[i].set(j + 1), st.voted_for))
+        st_rvq, rvq_ok = reply_slot(st_rvq, rvr_resp, s)
+        en_rvq = le & (mtype == RVQ)
+
+        # RequestVoteResponse: DropStaleResponse :382-385 / Handle :267-279.
+        en_rvr_drop = le & (mtype == RVR) & (mterm < t_i)
+        en_rvr = le & (mtype == RVR) & (mterm == t_i)
+        st_rvr = bag_discard_slot(
+            st._replace(
+                votes_resp=st.votes_resp.at[i].set(
+                    st.votes_resp[i] | (1 << j)),
+                votes_gran=st.votes_gran.at[i].set(
+                    st.votes_gran[i] | (jnp.where(mvec[4] > 0, 1, 0) << j))),
+            s)
+
+        # AppendEntriesRequest — raft.tla:347-356.
+        prev, pterm, n_ent = mvec[4], mvec[5], mvec[6]
+        eterm, eval_, mcommit = mvec[7], mvec[8], mvec[9]
+        aeq_logok = (prev == 0) | \
+            ((prev > 0) & (prev <= ln)
+             & (pterm == st.log_term[i, jnp.clip(prev - 1, 0, L - 1)]))
+        en_aeq = le & (mtype == AEQ)
+        # Reject — :281-293.
+        en_rej = en_aeq & ((mterm < t_i)
+                           | ((mterm == t_i) & (role_i == FOLLOWER)
+                              & ~aeq_logok))
+        rej_resp = base_msg(AER, i, j, t_i)        # success=0, matchIndex=0
+        st_rej, rej_ok = reply_slot(st, rej_resp, s)
+        # ReturnToFollowerState — :295-299 (message not consumed).
+        en_rtf = en_aeq & (mterm == t_i) & (role_i == CANDIDATE)
+        st_rtf = st._replace(role=st.role.at[i].set(FOLLOWER))
+        # Accept — :333-341, index == mprevLogIndex + 1.
+        acc = en_aeq & (mterm == t_i) & (role_i == FOLLOWER) & aeq_logok
+        index = prev + 1
+        have_at = ln >= index
+        term_at = st.log_term[i, jnp.clip(index - 1, 0, L - 1)]
+        done_shape = (n_ent == 0) | (have_at & (term_at == eterm))
+        # AlreadyDone — :301-317 with the :317 hidden guard.
+        en_done = acc & done_shape & (mcommit == st.commit[i])
+        done_resp = base_msg(AER, i, j, t_i) \
+            .at[4].set(1).at[5].set(prev + n_ent)               # :313
+        st_done, done_ok = reply_slot(st, done_resp, s)
+        # Conflict — :319-325: drop exactly one trailing entry, no reply.
+        en_conf = acc & (n_ent > 0) & have_at & (term_at != eterm)
+        k_last = jnp.clip(ln - 1, 0, L - 1)
+        st_conf = st._replace(
+            log_term=st.log_term.at[i, k_last].set(0),
+            log_val=st.log_val.at[i, k_last].set(0),
+            log_len=st.log_len.at[i].add(-1))
+        # NoConflict — :327-331: append mentries[1].
+        fits = ln < L
+        en_noc = acc & (n_ent > 0) & (ln == prev)
+        k_app = jnp.clip(ln, 0, L - 1)
+        st_noc = st._replace(
+            log_term=st.log_term.at[i, k_app].set(eterm),
+            log_val=st.log_val.at[i, k_app].set(eval_),
+            log_len=st.log_len.at[i].add(1))
+
+        # AppendEntriesResponse: DropStale :402 / Handle :360-370.
+        en_aer_drop = le & (mtype == AER) & (mterm < t_i)
+        en_aer = le & (mtype == AER) & (mterm == t_i)
+        succ, mmatch = mvec[4] > 0, mvec[5]
+        st_aer = bag_discard_slot(
+            st._replace(
+                next_idx=st.next_idx.at[i, j].set(
+                    jnp.where(succ, mmatch + 1,
+                              jnp.maximum(st.next_idx[i, j] - 1, 1))),
+                match_idx=st.match_idx.at[i, j].set(
+                    jnp.where(succ, mmatch, st.match_idx[i, j]))),
+            s)
+
+        st_drop = bag_discard_slot(st, s)
+
+        overflow = (en_rvq & ~rvq_ok) | (en_rej & ~rej_ok) | \
+            (en_done & ~done_ok) | (en_noc & ~fits)
+        enabled = (en_ut | en_rvq | en_rvr_drop | en_rvr | en_rej | en_rtf
+                   | en_done | en_conf | en_noc | en_aer_drop | en_aer) \
+            & ~overflow
+        out = st
+        for cond, branch in (
+                (en_ut, st_ut), (en_rvq, st_rvq),
+                (en_rvr_drop | en_aer_drop, st_drop),
+                (en_rvr, st_rvr), (en_rej, st_rej), (en_rtf, st_rtf),
+                (en_done, st_done), (en_conf, st_conf), (en_noc, st_noc),
+                (en_aer, st_aer)):
+            out = _sel(cond, branch, out)
+        return enabled, overflow, out
+
+    def duplicate(st: StateBatch, s):
+        """DuplicateMessage — raft.tla:410-412 (bag count +1)."""
+        occ = st.msg_cnt[s] > 0
+        return occ, _FALSE, st._replace(
+            msg_cnt=st.msg_cnt.at[s].add(jnp.where(occ, 1, 0)))
+
+    def drop(st: StateBatch, s):
+        """DropMessage — raft.tla:415-417 (bag count -1)."""
+        occ = st.msg_cnt[s] > 0
+        return occ, _FALSE, bag_discard_slot(st, s)
+
+    # -- grid assembly (Next — raft.tla:421-430) --------------------------
+    servers = jnp.arange(N, dtype=i32)
+    ii = jnp.repeat(jnp.arange(N, dtype=i32), N)
+    jj = jnp.tile(jnp.arange(N, dtype=i32), N)
+    ci = jnp.repeat(jnp.arange(N, dtype=i32), V)
+    cv = jnp.tile(jnp.arange(1, V + 1, dtype=i32), N)
+    slots = jnp.arange(M, dtype=i32)
+
+    def expand(st: StateBatch):
+        """All candidate successors of one state.  Returns
+        (cands [G,...], enabled [G], overflow [G]) with G = n_instances,
+        ordered per dims.family_offsets."""
+        outs = [
+            jax.vmap(restart, (None, 0))(st, servers),
+            jax.vmap(timeout, (None, 0))(st, servers),
+            jax.vmap(request_vote, (None, 0, 0))(st, ii, jj),
+            jax.vmap(become_leader, (None, 0))(st, servers),
+            jax.vmap(client_request, (None, 0, 0))(st, ci, cv),
+            jax.vmap(advance_commit, (None, 0))(st, servers),
+            jax.vmap(append_entries, (None, 0, 0))(st, ii, jj),
+            jax.vmap(receive, (None, 0))(st, slots),
+            jax.vmap(duplicate, (None, 0))(st, slots),
+            jax.vmap(drop, (None, 0))(st, slots),
+        ]
+        enabled = jnp.concatenate([o[0] for o in outs])
+        overflow = jnp.concatenate([o[1] for o in outs])
+        cands = jax.tree.map(lambda *xs: jnp.concatenate(xs),
+                             *(o[2] for o in outs))
+        return cands, enabled, overflow
+
+    return expand

@@ -1,0 +1,125 @@
+"""Invariant and state-constraint kernels.
+
+``build_type_ok`` is the tensor-side TypeOK (/root/reference/raft.tla:482-492).
+In the fixed-width encoding most of TypeOK holds *by construction* (fields are
+always int tensors of the right shape), so the kernel checks exactly the
+residual content conditions that encoding does not force:
+
+- roles in {Follower, Candidate, Leader}; votedFor in {Nil} ∪ Server;
+- log entries (below log_len) have Nat terms and values in Value; tails zero;
+- commitIndex ∈ Nat; nextIndex >= 1 (raft.tla:491); matchIndex ∈ Nat;
+- vote bitmasks ⊆ Server; message rows well-typed per the :443-479 schemas
+  with positive bag multiplicities.
+
+``build_constraint`` builds the CONSTRAINT predicate for bounded exhaustive
+runs (SURVEY §2.4 R9).  TLC semantics: a state violating the constraint is
+still generated, invariant-checked and counted distinct, but not expanded —
+the engine applies this predicate only when deciding what to enqueue.  The
+reference's MCraft.cfg sets no constraint (the space is unbounded as
+configured); bounds here (MaxTerm / MaxLogLen / per-message count cap) are
+the BASELINE.json bounded configs.  The count cap also bounds
+``DuplicateMessage`` (raft.tla:410), which is what keeps the bag finite.
+
+The oracle mirrors (``*_py``) keep differential tests honest.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import jax.numpy as jnp
+
+from .dims import RaftDims
+from .pystate import PyState
+from .schema import StateBatch
+
+
+def build_type_ok(dims: RaftDims):
+    N, V, L = dims.n_servers, dims.n_values, dims.max_log
+
+    def type_ok(st: StateBatch):
+        lane = jnp.arange(L)[None, :]
+        in_log = lane < st.log_len[:, None]
+        occ = st.msg_cnt > 0
+        mt = st.msg[:, 0]
+        src, dst = st.msg[:, 1], st.msg[:, 2]
+        checks = [
+            jnp.all((st.role >= 0) & (st.role <= 2)),
+            jnp.all((st.voted_for >= 0) & (st.voted_for <= N)),
+            jnp.all(jnp.where(in_log, (st.log_term >= 0)
+                              & (st.log_val >= 1) & (st.log_val <= V),
+                              (st.log_term == 0) & (st.log_val == 0))),
+            jnp.all((st.log_len >= 0) & (st.log_len <= L)),
+            jnp.all(st.term >= 0) & jnp.all(st.commit >= 0),
+            jnp.all((st.votes_resp >= 0) & (st.votes_resp < (1 << N))),
+            jnp.all((st.votes_gran >= 0) & (st.votes_gran < (1 << N))),
+            jnp.all(st.next_idx >= 1),          # raft.tla:491
+            jnp.all(st.match_idx >= 0),
+            jnp.all(jnp.where(occ,
+                              (mt >= 1) & (mt <= 4)
+                              & (src >= 1) & (src <= N)
+                              & (dst >= 1) & (dst <= N)
+                              & (st.msg[:, 3] >= 0),
+                              jnp.all(st.msg == 0, axis=1))),
+            jnp.all(st.msg_cnt >= 0),
+        ]
+        out = checks[0]
+        for c in checks[1:]:
+            out = out & c
+        return out
+
+    return type_ok
+
+
+def type_ok_py(s: PyState, dims: RaftDims) -> bool:
+    """Oracle-side TypeOK (subset mirroring build_type_ok's content checks)."""
+    n, v = dims.n_servers, dims.n_values
+    ok = all(0 <= r <= 2 for r in s.role)
+    ok &= all(0 <= vf <= n for vf in s.voted_for)
+    ok &= all(t >= 0 and 1 <= val <= v for log in s.log for (t, val) in log)
+    ok &= all(t >= 0 for t in s.current_term)
+    ok &= all(c >= 0 for c in s.commit_index)
+    ok &= all(0 <= m < (1 << n)
+              for m in s.votes_responded + s.votes_granted)
+    ok &= all(x >= 1 for row in s.next_index for x in row)
+    ok &= all(x >= 0 for row in s.match_index for x in row)
+    ok &= all(c >= 1 for _m, c in s.messages)
+    return ok
+
+
+@dataclasses.dataclass(frozen=True)
+class Bounds:
+    """CONSTRAINT bounds for exhaustive runs (BASELINE.json configs)."""
+
+    max_term: Optional[int] = None       # \A i : currentTerm[i] <= MaxTerm
+    max_log_len: Optional[int] = None    # \A i : Len(log[i]) <= MaxLogLen
+    max_msg_count: Optional[int] = None  # \A m : messages[m] <= MaxDup
+
+
+def build_constraint(dims: RaftDims, bounds: Bounds):
+    def constraint(st: StateBatch):
+        ok = jnp.bool_(True)
+        if bounds.max_term is not None:
+            ok = ok & jnp.all(st.term <= bounds.max_term)
+        if bounds.max_log_len is not None:
+            ok = ok & jnp.all(st.log_len <= bounds.max_log_len)
+        if bounds.max_msg_count is not None:
+            ok = ok & jnp.all(st.msg_cnt <= bounds.max_msg_count)
+        return ok
+
+    return constraint
+
+
+def constraint_py(bounds: Bounds):
+    def constraint(s: PyState, dims: RaftDims) -> bool:
+        ok = True
+        if bounds.max_term is not None:
+            ok &= max(s.current_term) <= bounds.max_term
+        if bounds.max_log_len is not None:
+            ok &= max(len(l) for l in s.log) <= bounds.max_log_len
+        if bounds.max_msg_count is not None:
+            ok &= all(c <= bounds.max_msg_count for _m, c in s.messages)
+        return ok
+
+    return constraint

@@ -1,0 +1,184 @@
+"""Struct-of-arrays state schema: the spec's variables as fixed-width tensors.
+
+``vars == <<messages, serverVars, candidateVars, leaderVars, logVars>>``
+(/root/reference/raft.tla:74) becomes ``StateBatch``, a NamedTuple pytree of
+int32 tensors.  Used both per-state (no leading axis, inside kernels) and
+batched (leading frontier axis, under vmap).  Encoding conventions are
+documented in ``dims.py``; the invariants that keep states canonical for
+fingerprinting are:
+
+- log lanes at positions >= log_len are zero;
+- free message slots (count == 0) are all-zero rows;
+- votedFor uses 0 for Nil; bitmask bits beyond n_servers are zero.
+
+``encode_state``/``decode_state`` convert to/from the oracle's ``PyState``
+(host-side, numpy) for differential testing and trace pretty-printing.
+"""
+
+from __future__ import annotations
+
+from typing import List, NamedTuple
+
+import numpy as np
+
+from .dims import AEQ, RVQ, RVR, RaftDims
+from .pystate import PyState
+
+
+class StateBatch(NamedTuple):
+    """One Raft global state (or a batch: add leading axes uniformly)."""
+
+    term: "np.ndarray"        # [N]    currentTerm  raft.tla:37
+    role: "np.ndarray"        # [N]    state        raft.tla:39
+    voted_for: "np.ndarray"   # [N]    votedFor     raft.tla:42 (0=Nil)
+    log_term: "np.ndarray"    # [N,L]  log entry terms   raft.tla:48
+    log_val: "np.ndarray"     # [N,L]  log entry values
+    log_len: "np.ndarray"     # [N]    Len(log[i])
+    commit: "np.ndarray"      # [N]    commitIndex  raft.tla:50
+    votes_resp: "np.ndarray"  # [N]    votesResponded bitmask  raft.tla:56
+    votes_gran: "np.ndarray"  # [N]    votesGranted bitmask    raft.tla:59
+    next_idx: "np.ndarray"    # [N,N]  nextIndex    raft.tla:64
+    match_idx: "np.ndarray"   # [N,N]  matchIndex   raft.tla:67
+    msg: "np.ndarray"         # [M,W]  distinct in-flight messages raft.tla:31
+    msg_cnt: "np.ndarray"     # [M]    bag multiplicities
+
+
+def encode_message(m: tuple, dims: RaftDims) -> np.ndarray:
+    """Message tuple (pystate.py layout) -> [W] int32 row (dims.py layout)."""
+    w = np.zeros(dims.msg_width, np.int32)
+    mtype, src, dst, mterm = m[0], m[1], m[2], m[3]
+    w[0], w[1], w[2], w[3] = mtype + 1, src + 1, dst + 1, mterm
+    if mtype == RVQ:
+        w[4], w[5] = m[4], m[5]
+    elif mtype == RVR:
+        granted, mlog = m[4], m[5]
+        w[4], w[5] = granted, len(mlog)
+        for k, (t, v) in enumerate(mlog):
+            w[6 + k] = t
+            w[6 + dims.max_log + k] = v
+    elif mtype == AEQ:
+        prev, pterm, entries, mcommit = m[4], m[5], m[6], m[7]
+        w[4], w[5], w[6] = prev, pterm, len(entries)
+        if entries:
+            w[7], w[8] = entries[0]
+        w[9] = mcommit
+    else:  # AER
+        w[4], w[5] = m[4], m[5]
+    return w
+
+
+def decode_message(w: np.ndarray, dims: RaftDims) -> tuple:
+    mtype = int(w[0]) - 1
+    src, dst, mterm = int(w[1]) - 1, int(w[2]) - 1, int(w[3])
+    if mtype == RVQ:
+        return (RVQ, src, dst, mterm, int(w[4]), int(w[5]))
+    if mtype == RVR:
+        ln = int(w[5])
+        mlog = tuple((int(w[6 + k]), int(w[6 + dims.max_log + k]))
+                     for k in range(ln))
+        return (RVR, src, dst, mterm, int(w[4]), mlog)
+    if mtype == AEQ:
+        n_ent = int(w[6])
+        entries = ((int(w[7]), int(w[8])),) if n_ent else ()
+        return (AEQ, src, dst, mterm, int(w[4]), int(w[5]), entries, int(w[9]))
+    return (3, src, dst, mterm, int(w[4]), int(w[5]))
+
+
+def encode_state(s: PyState, dims: RaftDims) -> StateBatch:
+    """PyState -> single-state StateBatch (numpy int32, no leading axis)."""
+    n, L, M = dims.n_servers, dims.max_log, dims.n_msg_slots
+    log_term = np.zeros((n, L), np.int32)
+    log_val = np.zeros((n, L), np.int32)
+    log_len = np.zeros(n, np.int32)
+    for i, log in enumerate(s.log):
+        if len(log) > L:
+            raise ValueError(f"log length {len(log)} exceeds capacity {L}")
+        log_len[i] = len(log)
+        for k, (t, v) in enumerate(log):
+            log_term[i, k], log_val[i, k] = t, v
+    bag = sorted(s.messages)
+    if len(bag) > M:
+        raise ValueError(f"{len(bag)} distinct messages exceed {M} slots")
+    msg = np.zeros((M, dims.msg_width), np.int32)
+    msg_cnt = np.zeros(M, np.int32)
+    for slot, (m, c) in enumerate(bag):
+        msg[slot] = encode_message(m, dims)
+        msg_cnt[slot] = c
+    return StateBatch(
+        term=np.asarray(s.current_term, np.int32),
+        role=np.asarray(s.role, np.int32),
+        voted_for=np.asarray(s.voted_for, np.int32),
+        log_term=log_term, log_val=log_val, log_len=log_len,
+        commit=np.asarray(s.commit_index, np.int32),
+        votes_resp=np.asarray(s.votes_responded, np.int32),
+        votes_gran=np.asarray(s.votes_granted, np.int32),
+        next_idx=np.asarray(s.next_index, np.int32),
+        match_idx=np.asarray(s.match_index, np.int32),
+        msg=msg, msg_cnt=msg_cnt)
+
+
+def stack_states(states: List[StateBatch]) -> StateBatch:
+    return StateBatch(*(np.stack(cols) for cols in zip(*states)))
+
+
+def decode_state(st: StateBatch, dims: RaftDims) -> PyState:
+    """Single-state StateBatch -> PyState (host-side)."""
+    n = dims.n_servers
+    a = StateBatch(*(np.asarray(x) for x in st))
+    logs = tuple(
+        tuple((int(a.log_term[i, k]), int(a.log_val[i, k]))
+              for k in range(int(a.log_len[i])))
+        for i in range(n))
+    bag = frozenset(
+        (decode_message(a.msg[s], dims), int(a.msg_cnt[s]))
+        for s in range(dims.n_msg_slots) if a.msg_cnt[s] > 0)
+    return PyState(
+        current_term=tuple(int(x) for x in a.term),
+        role=tuple(int(x) for x in a.role),
+        voted_for=tuple(int(x) for x in a.voted_for),
+        log=logs,
+        commit_index=tuple(int(x) for x in a.commit),
+        votes_responded=tuple(int(x) for x in a.votes_resp),
+        votes_granted=tuple(int(x) for x in a.votes_gran),
+        next_index=tuple(tuple(int(x) for x in row) for row in a.next_idx),
+        match_index=tuple(tuple(int(x) for x in row) for row in a.match_idx),
+        messages=bag)
+
+
+# ---------------------------------------------------------------------------
+# Flat row form: the BFS queues store states as [state_width] int32 rows
+# (one concatenation of every field); cheap reshape/concat both ways.
+
+def state_width(dims: RaftDims) -> int:
+    n, L, M, W = (dims.n_servers, dims.max_log, dims.n_msg_slots,
+                  dims.msg_width)
+    return n * 7 + 2 * n * L + 2 * n * n + M * W + M
+
+
+def flatten_state(st: StateBatch, dims: RaftDims):
+    """StateBatch (single state) -> [state_width] int32 row.  Works under
+    vmap for batches.  Import-free of jax: uses the array namespace of its
+    inputs (numpy or jnp)."""
+    parts = [st.term, st.role, st.voted_for, st.log_term.reshape(-1),
+             st.log_val.reshape(-1), st.log_len, st.commit, st.votes_resp,
+             st.votes_gran, st.next_idx.reshape(-1),
+             st.match_idx.reshape(-1), st.msg.reshape(-1), st.msg_cnt]
+    if isinstance(st.term, np.ndarray):
+        return np.concatenate([np.asarray(p, np.int32).reshape(-1)
+                               for p in parts])
+    import jax.numpy as jnp  # jax arrays and tracers
+    return jnp.concatenate(parts)
+
+
+def unflatten_state(row, dims: RaftDims) -> StateBatch:
+    """[state_width] int32 row -> StateBatch.  Works under vmap."""
+    n, L, M, W = (dims.n_servers, dims.max_log, dims.n_msg_slots,
+                  dims.msg_width)
+    sizes = [n, n, n, n * L, n * L, n, n, n, n, n * n, n * n, M * W, M]
+    shapes = [(n,), (n,), (n,), (n, L), (n, L), (n,), (n,), (n,), (n,),
+              (n, n), (n, n), (M, W), (M,)]
+    out, off = [], 0
+    for sz, shp in zip(sizes, shapes):
+        out.append(row[off:off + sz].reshape(shp))
+        off += sz
+    return StateBatch(*out)

@@ -1,0 +1,99 @@
+"""State fingerprinting — TLC's 64-bit fingerprints, TPU-native.
+
+TLC identifies states by a 64-bit fingerprint and dedups on fingerprints
+alone, accepting a vanishingly small collision probability [TLC semantics —
+external].  We reproduce that contract with **two independent 32-bit lanes**
+instead of one emulated u64 (TPUs have no native 64-bit integers; everything
+here stays in uint32 on the VPU):
+
+- the *ordered* part of the state (all server-indexed tensors; order is
+  semantic, there is no symmetry reduction) is hashed with a multilinear
+  pass: ``sum(x * C_lane) mod 2^32`` with fixed random odd constants —
+  an almost-universal family;
+- the *message bag* (raft.tla:31) must hash order-invariantly in slot
+  order, so each occupied slot row is mixed to a per-message hash and the
+  bag contributes ``sum(mix(row) * count)`` — the standard commutative
+  multiset hash.  Equal bags give equal sums regardless of slot layout,
+  and multiplicities are respected without any sorting pass;
+- lane values are finalized with the murmur3 fmix32 avalanche.
+
+Two independent lanes give an effective ~2^-64 pairwise collision rate,
+matching TLC's regime.  The pair (hi, lo) is also the key layout the
+sorted fingerprint set (ops/fpset.py) sorts on with a two-key lexsort.
+
+The all-ones pair is reserved as the FPSet's empty/pad sentinel; real
+fingerprints landing on it are remapped deterministically.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..models.dims import RaftDims
+from ..models.schema import StateBatch
+
+_U32 = jnp.uint32
+SENTINEL = np.uint32(0xFFFFFFFF)
+
+
+def fmix32(x):
+    """murmur3 finalizer: full-avalanche 32-bit mixer."""
+    x = x ^ (x >> 16)
+    x = x * _U32(0x85EBCA6B)
+    x = x ^ (x >> 13)
+    x = x * _U32(0xC2B2AE35)
+    x = x ^ (x >> 16)
+    return x
+
+
+def _flat_ordered(st: StateBatch):
+    """Concatenate every server-indexed field (order is part of state
+    identity; nextIndex/matchIndex diagonals included — raft.tla:118-120)."""
+    parts = [st.term, st.role, st.voted_for, st.log_term.reshape(-1),
+             st.log_val.reshape(-1), st.log_len, st.commit, st.votes_resp,
+             st.votes_gran, st.next_idx.reshape(-1),
+             st.match_idx.reshape(-1)]
+    return jnp.concatenate([p.astype(jnp.int32) for p in parts]).view(_U32)
+
+
+def build_fingerprint(dims: RaftDims):
+    """Returns ``fp(state) -> (hi, lo)`` for a single state; vmap for
+    batches.  Constants are fixed (seeded) so fingerprints are stable
+    across processes — required for checkpoint/resume compatibility."""
+    n, L = dims.n_servers, dims.max_log
+    # 7 scalar lanes per server (term, role, votedFor, logLen, commit,
+    # votesResponded, votesGranted) + 2 log planes + nextIndex/matchIndex.
+    d_ordered = n * (7 + 2 * L) + 2 * n * n
+    rng = np.random.RandomState(0x7A57)  # fixed seed: fingerprint stability
+    consts = {}
+    for lane in (0, 1):
+        consts[lane] = (
+            jnp.asarray(rng.randint(0, 1 << 32, d_ordered,
+                                    dtype=np.uint64).astype(np.uint32) | 1),
+            jnp.asarray(rng.randint(0, 1 << 32, dims.msg_width,
+                                    dtype=np.uint64).astype(np.uint32) | 1),
+            _U32(rng.randint(1, 1 << 32, dtype=np.uint64) | 1),
+        )
+
+    def lane_hash(st, flat, lane):
+        c_ord, c_msg, seed = consts[lane]
+        base = jnp.sum(flat * c_ord, dtype=_U32)
+        rows = st.msg.view(_U32) if st.msg.dtype != jnp.uint32 else st.msg
+        slot_h = fmix32(jnp.sum(rows * c_msg[None, :], axis=1,
+                                dtype=_U32) ^ seed)               # [M]
+        occupied = st.msg_cnt > 0
+        msum = jnp.sum(jnp.where(occupied, slot_h
+                                 * st.msg_cnt.astype(_U32), _U32(0)),
+                       dtype=_U32)
+        return fmix32(base + msum * _U32(0x9E3779B9) + seed)
+
+    def fingerprint(st: StateBatch):
+        flat = _flat_ordered(st)
+        hi = lane_hash(st, flat, 0)
+        lo = lane_hash(st, flat, 1)
+        # Reserve the all-ones pair for the FPSet sentinel.
+        is_sent = (hi == SENTINEL) & (lo == SENTINEL)
+        return hi, jnp.where(is_sent, _U32(0xFFFFFFFE), lo)
+
+    return fingerprint

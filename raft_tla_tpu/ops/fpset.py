@@ -1,0 +1,97 @@
+"""The seen-state set — TLC's FPSet rebuilt as a sorted HBM array.
+
+TLC keeps seen-state fingerprints in an in-memory/disk hash set probed one
+state at a time [TLC semantics — external].  A TPU wants the opposite shape:
+**batched, sort-based, branch-free**.  This FPSet is a fixed-capacity pair of
+uint32 arrays (the two fingerprint lanes) kept lexicographically sorted, with
+all free space holding the all-ones sentinel (which sorts to the tail):
+
+- ``contains``: vectorized lower-bound binary search — ``log2(C)`` gather
+  rounds over the whole query batch at once (XLA compiles this to a tight
+  fori loop; no data-dependent shapes);
+- ``merge``: concatenate + two-key ``lax.sort`` + slice.  Sorting is one of
+  the things XLA/TPU does extremely well, and a level-synchronous BFS only
+  merges once per level, so the amortized cost per state is tiny;
+- in-batch dedup of candidate fingerprints rides the same sort (payload =
+  original index, ``num_keys=2``).
+
+Capacity is static; the engine host-checks ``size`` and raises before
+overflow — a checker must never silently forget states.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .fingerprint import SENTINEL
+
+_U32 = jnp.uint32
+
+
+class FPSet(NamedTuple):
+    hi: jnp.ndarray    # [C] uint32, lex-sorted (hi, lo), sentinel-padded
+    lo: jnp.ndarray    # [C] uint32
+    size: jnp.ndarray  # [] int32 — number of real keys
+
+
+def empty(capacity: int) -> FPSet:
+    return FPSet(hi=jnp.full((capacity,), SENTINEL, _U32),
+                 lo=jnp.full((capacity,), SENTINEL, _U32),
+                 size=jnp.int32(0))
+
+
+def contains(s: FPSet, qhi, qlo):
+    """Membership for a batch of fingerprint pairs.  [K] bool."""
+    c = s.hi.shape[0]
+    lo_b = jnp.zeros(qhi.shape, jnp.int32)
+    hi_b = jnp.full(qhi.shape, c, jnp.int32)
+    steps = max(1, int(np.ceil(np.log2(c + 1))) + 1)
+    for _ in range(steps):                       # static unroll: log2(C)
+        mid = (lo_b + hi_b) >> 1
+        mh, ml = s.hi[mid], s.lo[mid]
+        less = (mh < qhi) | ((mh == qhi) & (ml < qlo))
+        lo_b = jnp.where(less, mid + 1, lo_b)
+        hi_b = jnp.where(less, hi_b, mid)
+    at = jnp.clip(lo_b, 0, c - 1)
+    return (s.hi[at] == qhi) & (s.lo[at] == qlo) & (lo_b < c)
+
+
+def dedup_batch(khi, klo, valid):
+    """In-batch first-occurrence marking.  Returns ((sorted_hi, sorted_lo),
+    order, first_occ): the lex-sorted keys, the sort permutation (original
+    indices), and a mask marking the first occurrence of each distinct
+    non-sentinel key in sorted order."""
+    k = khi.shape[0]
+    khi = jnp.where(valid, khi, SENTINEL)
+    klo = jnp.where(valid, klo, SENTINEL)
+    sh, sl, order = jax.lax.sort((khi, klo, jnp.arange(k, dtype=jnp.int32)),
+                                 num_keys=2)
+    is_sent = (sh == SENTINEL) & (sl == SENTINEL)
+    prev_ne = jnp.concatenate([
+        jnp.array([True]),
+        (sh[1:] != sh[:-1]) | (sl[1:] != sl[:-1])])
+    return (sh, sl), order, prev_ne & ~is_sent
+
+
+def merge(s: FPSet, new_hi, new_lo, new_valid) -> FPSet:
+    """Insert a batch of (assumed not-already-present) keys; keeps the array
+    sorted.  Invalid lanes are sentinels and fall off the concat+sort+slice
+    iff size + #valid <= capacity (engine checks ``size`` after)."""
+    c = s.hi.shape[0]
+    nh = jnp.where(new_valid, new_hi, SENTINEL)
+    nl = jnp.where(new_valid, new_lo, SENTINEL)
+    ch = jnp.concatenate([s.hi, nh])
+    cl = jnp.concatenate([s.lo, nl])
+    sh, sl = jax.lax.sort((ch, cl), num_keys=2)
+    return FPSet(hi=sh[:c], lo=sl[:c],
+                 size=s.size + jnp.sum(new_valid, dtype=jnp.int32))
+
+
+def to_host_keys(s: FPSet) -> Tuple[np.ndarray, np.ndarray]:
+    """Materialize the real keys host-side (checkpointing)."""
+    n = int(s.size)
+    return np.asarray(s.hi[:n]), np.asarray(s.lo[:n])

@@ -1,18 +1,43 @@
 """Test harness configuration.
 
-Tests run on a virtual 8-device CPU mesh (SURVEY §4.5): the environment
-variables below MUST be set before jax initializes its backend, which is why
-they live at conftest import time.  Real-TPU execution is exercised by
-``bench.py`` / ``__graft_entry__.py``, not the unit suite.
+Tests run on a virtual 8-device CPU mesh (SURVEY §4.5).  The ambient
+environment pins jax to the real-TPU tunnel: a ``sitecustomize`` hook
+registers the ``axon`` PJRT plugin at interpreter start and sets
+``jax_platforms="axon,cpu"`` by config (so env vars set later are
+ineffective), and any backend initialization then blocks on the TPU relay.
+Unit tests must never touch the relay, so before any test imports run we
+(1) point ``jax_platforms`` back at cpu, (2) drop the registered axon
+factory, and (3) request 8 virtual CPU devices for the mesh-sharding tests.
+Real-TPU execution is exercised by ``bench.py`` / ``__graft_entry__.py``
+under the ambient environment, never by the unit suite.
 """
 
 import os
 import sys
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+try:
+    from jax._src import xla_bridge
+
+    xla_bridge._backend_factories.pop("axon", None)
+except Exception:  # registry layout varies across jax versions
+    pass
+
+# Persistent compilation cache: the expand/step programs take tens of
+# seconds to compile on this single-core CPU; caching makes re-runs cheap.
+_cache = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                      ".jax_cache")
+try:
+    jax.config.update("jax_compilation_cache_dir", _cache)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+except Exception:
+    pass
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
