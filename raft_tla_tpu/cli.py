@@ -1,0 +1,126 @@
+"""Command-line interface — the ``java tlc2.TLC -config X.cfg X.tla`` analog.
+
+    python -m raft_tla_tpu check    <cfg> [engine options]
+    python -m raft_tla_tpu simulate <cfg> [--num-steps N --depth D]
+
+Platform selection: by default jax picks the ambient backend (the real TPU
+where available).  ``--platform cpu`` forces CPU and must be applied before
+jax initializes, which is why all heavy imports here are deferred until
+after argument parsing.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+
+def _force_platform(platform: str):
+    os.environ["JAX_PLATFORMS"] = platform
+    import jax
+    jax.config.update("jax_platforms", platform)
+    if platform == "cpu":
+        try:
+            from jax._src import xla_bridge
+            xla_bridge._backend_factories.pop("axon", None)
+        except Exception:
+            pass
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(prog="raft_tla_tpu")
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    def common(sp):
+        sp.add_argument("cfg", help="TLC .cfg file (e.g. MCraft.cfg)")
+        sp.add_argument("--platform", default=None,
+                        help="jax platform override (e.g. cpu)")
+        sp.add_argument("--batch", type=int, default=1024)
+        sp.add_argument("--n-msg-slots", type=int, default=32)
+        sp.add_argument("--max-log", type=int, default=None)
+        sp.add_argument("--seed", type=int, default=0)
+
+    c = sub.add_parser("check", help="exhaustive BFS check")
+    common(c)
+    c.add_argument("--queue-capacity", type=int, default=1 << 20)
+    c.add_argument("--seen-capacity", type=int, default=1 << 22)
+    c.add_argument("--max-diameter", type=int, default=None)
+    c.add_argument("--max-seconds", type=float, default=None)
+    c.add_argument("--no-trace", action="store_true",
+                   help="disable counterexample trace recording")
+
+    s = sub.add_parser("simulate", help="random-trace simulation")
+    common(s)
+    s.add_argument("--num-steps", type=int, default=1 << 20)
+    s.add_argument("--depth", type=int, default=100)
+    s.add_argument("--max-seconds", type=float, default=None)
+
+    args = p.parse_args(argv)
+    if args.platform:
+        _force_platform(args.platform)
+
+    from .engine.bfs import EngineConfig
+    from .engine.check import (format_result, initial_states, make_engine)
+    from .models.pystate import format_state
+    from .utils.cfg import load_config
+
+    setup = load_config(args.cfg, max_log=args.max_log,
+                        n_msg_slots=args.n_msg_slots)
+    print(f"model: {setup.dims.n_servers} servers "
+          f"{tuple(setup.server_names)}, {setup.dims.n_values} values; "
+          f"smoke={setup.smoke} invariants={setup.invariants} "
+          f"bounds={setup.bounds}")
+
+    if args.cmd == "check":
+        cfgobj = EngineConfig(
+            batch=args.batch, queue_capacity=args.queue_capacity,
+            seen_capacity=args.seen_capacity,
+            max_diameter=args.max_diameter, max_seconds=args.max_seconds,
+            record_trace=not args.no_trace)
+        engine = make_engine(setup, cfgobj)
+        res = engine.run(initial_states(setup, seed=args.seed))
+        print(format_result(res))
+        if res.violation is not None:
+            print("\ncounterexample trace:")
+            for g, st in engine.replay(res.violation.fingerprint):
+                label = ("Initial state" if g < 0
+                         else setup.dims.describe_instance(g))
+                print(f"-- {label}")
+                print(format_state(st, setup.dims))
+            return 1
+        if res.deadlock is not None:
+            print("\ndeadlock state:")
+            print(format_state(res.deadlock, setup.dims))
+            return 1
+        return 0
+
+    # simulate
+    from .engine.check import resolve_constraint, resolve_invariants
+    from .engine.simulate import Simulator
+    sim = Simulator(setup.dims, invariants=resolve_invariants(setup),
+                    constraint=resolve_constraint(setup),
+                    batch=args.batch, depth=args.depth)
+    max_seconds = (args.max_seconds if args.max_seconds is not None
+                   else setup.max_seconds)   # StopAfter duration budget
+    res = sim.run(initial_states(setup, seed=args.seed),
+                  num_steps=args.num_steps, seed=args.seed,
+                  max_seconds=max_seconds)
+    print(f"steps visited      {res.steps}")
+    print(f"traces             {res.traces}")
+    print(f"wall seconds       {res.wall_seconds:.2f}")
+    print(f"states/sec         {res.states_per_second:.0f}")
+    if res.violation_invariant is not None:
+        print(f"VIOLATION          {res.violation_invariant}")
+        if res.violation_trace:
+            for g, st in res.violation_trace:
+                label = ("Initial state" if g < 0
+                         else setup.dims.describe_instance(g))
+                print(f"-- {label}")
+                print(format_state(st, setup.dims))
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

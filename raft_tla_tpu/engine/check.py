@@ -1,0 +1,105 @@
+"""Front-end: resolved cfg -> engine run (the ``tlc <cfg> <module>`` path).
+
+Maps a ``CheckSetup`` (utils/cfg.py) onto the BFS engine: invariant names
+resolve through the registry below (TypeOK today; the raft.tla dead-region
+safety suite registers here as it lands), constraint names resolve to
+predicate builders (``BoundedSpace`` reads the MaxTerm/MaxLogLen/MaxMsgCount
+constants), ``Init <- SmokeInit`` selects the randomized smoke roots
+(Smokeraft.cfg:43-44), and StopAfter budgets land in EngineConfig.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Optional
+
+from ..models import smoke
+from ..models.dims import RaftDims
+from ..models.invariants import Bounds, build_constraint, build_type_ok
+from ..models.pystate import PyState, init_state
+from ..utils.cfg import CheckSetup, load_config
+from .bfs import BFSEngine, EngineConfig, EngineResult
+
+# name -> builder(dims) -> kernel(state)->bool.  The dead-region safety
+# invariants (SURVEY §2.3) register here.
+INVARIANT_REGISTRY: Dict[str, Callable[[RaftDims], Callable]] = {
+    "TypeOK": build_type_ok,
+}
+
+CONSTRAINT_REGISTRY: Dict[str, Callable[[RaftDims, Bounds], Callable]] = {
+    "BoundedSpace": build_constraint,
+}
+
+
+def resolve_invariants(setup: CheckSetup) -> Dict[str, Callable]:
+    invs = {}
+    for name in setup.invariants:
+        if name not in INVARIANT_REGISTRY:
+            raise ValueError(
+                f"unknown INVARIANT {name!r}; registered: "
+                f"{sorted(INVARIANT_REGISTRY)}")
+        invs[name] = INVARIANT_REGISTRY[name](setup.dims)
+    return invs
+
+
+def resolve_constraint(setup: CheckSetup) -> Optional[Callable]:
+    constraint = None
+    for name in setup.constraints:
+        if name not in CONSTRAINT_REGISTRY:
+            raise ValueError(
+                f"unknown CONSTRAINT {name!r}; registered: "
+                f"{sorted(CONSTRAINT_REGISTRY)}")
+        if constraint is not None:
+            raise ValueError("multiple constraints not yet supported")
+        constraint = CONSTRAINT_REGISTRY[name](setup.dims, setup.bounds)
+    return constraint
+
+
+def make_engine(setup: CheckSetup,
+                engine_config: Optional[EngineConfig] = None) -> BFSEngine:
+    import dataclasses as _dc
+    base = engine_config or EngineConfig()
+    cfg = _dc.replace(          # never mutate the caller's config
+        base,
+        check_deadlock=setup.check_deadlock,
+        max_seconds=(base.max_seconds if base.max_seconds is not None
+                     else setup.max_seconds),
+        max_diameter=(base.max_diameter if base.max_diameter is not None
+                      else setup.max_diameter))
+    return BFSEngine(setup.dims, invariants=resolve_invariants(setup),
+                     constraint=resolve_constraint(setup), config=cfg)
+
+
+def initial_states(setup: CheckSetup, seed: int = 0) -> List[PyState]:
+    if setup.smoke:
+        return smoke.smoke_init_states(setup.dims, k=setup.smoke_k,
+                                       seed=seed)
+    return [init_state(setup.dims)]
+
+
+def run_check(cfg_path: str, engine_config: Optional[EngineConfig] = None,
+              seed: int = 0, max_log: Optional[int] = None,
+              n_msg_slots: int = 32) -> EngineResult:
+    """One-call path: parse cfg, build engine, run.  The reference configs
+    (/root/reference/MCraft.cfg, Smokeraft.cfg) run unmodified."""
+    setup = load_config(cfg_path, max_log=max_log, n_msg_slots=n_msg_slots)
+    engine = make_engine(setup, engine_config)
+    res = engine.run(initial_states(setup, seed=seed))
+    res.engine = engine
+    return res
+
+
+def format_result(res: EngineResult) -> str:
+    lines = [
+        f"distinct states    {res.distinct}",
+        f"states generated   {res.generated}",
+        f"diameter           {res.diameter}",
+        f"stop reason        {res.stop_reason}",
+        f"wall seconds       {res.wall_seconds:.2f}",
+        f"states/sec         {res.states_per_second:.0f}",
+    ]
+    if res.violation is not None:
+        lines.append(f"VIOLATION          {res.violation.invariant} "
+                     f"(fp {res.violation.fingerprint:#018x})")
+    if res.deadlock is not None:
+        lines.append("DEADLOCK reached")
+    return "\n".join(lines)

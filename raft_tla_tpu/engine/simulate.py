@@ -1,0 +1,187 @@
+"""Simulation mode — TLC's ``-simulate`` as a vmap'd random-walk kernel.
+
+TLC simulation generates random traces: from an initial state, repeatedly
+pick a *uniformly random enabled* action instance, check invariants along the
+way, and restart when the trace reaches the depth bound or cannot be extended
+[TLC semantics — external; SURVEY §3.4].  The TPU shape is B independent
+walkers advanced in lockstep by one ``lax.scan``:
+
+    states [B] -> vmap(expand) -> enabled [B,G]
+               -> masked categorical draw (one PRNG key per step)
+               -> tree-gather the chosen successor per walker
+               -> invariant ids; constraint/dead-end/depth-bound restarts
+
+Each walker carries its current root index and a [depth] ring of the action
+ids taken since its last restart, so the first violation latches a complete
+(root, action sequence) pair on device; the host replays it through the
+expand kernel into a full counterexample trace — the same replay mechanism
+the BFS engine uses.  There is no seen-set — simulation never dedups — so
+this mode exercises the pure expansion throughput of the machine.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Callable, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..models.actions import build_expand
+from ..models.dims import RaftDims
+from ..models.pystate import PyState
+from ..models.schema import (StateBatch, decode_state, encode_state,
+                             flatten_state, state_width, unflatten_state)
+
+_I32 = jnp.int32
+
+
+@dataclasses.dataclass
+class SimResult:
+    steps: int = 0                  # states visited (one per walker-step)
+    traces: int = 0                 # traces started (initial B + restarts)
+    wall_seconds: float = 0.0
+    violation_invariant: Optional[str] = None
+    violation_state: Optional[PyState] = None
+    violation_trace: Optional[List[Tuple[int, PyState]]] = None
+
+    @property
+    def states_per_second(self) -> float:
+        return self.steps / self.wall_seconds if self.wall_seconds else 0.0
+
+
+class Simulator:
+    def __init__(self, dims: RaftDims,
+                 invariants: Optional[Dict[str, Callable]] = None,
+                 constraint: Optional[Callable] = None,
+                 batch: int = 256, depth: int = 100, chunk: int = 128):
+        self.dims = dims
+        self.inv_names = list((invariants or {}).keys())
+        inv_fns = list((invariants or {}).values())
+        self.batch, self.depth, self.chunk = batch, depth, chunk
+        expand = build_expand(dims)
+        self._sw = state_width(dims)
+        B, G, D = batch, dims.n_instances, depth
+
+        def inv_id(st: StateBatch):
+            out = jnp.int32(-1)
+            for q in range(len(inv_fns) - 1, -1, -1):
+                out = jnp.where(inv_fns[q](st), out, jnp.int32(q))
+            return out
+
+        def body(carry, key):
+            (rows, roots, tstep, cur_root, abuf, restarts, latch) = carry
+            states = jax.vmap(unflatten_state, (0, None))(rows, dims)
+            cands, en, ovf = jax.vmap(expand)(states)
+            # Uniform choice among enabled instances (masked categorical).
+            logits = jnp.where(en, 0.0, -jnp.inf)
+            choice = jax.random.categorical(key, logits, axis=-1)    # [B]
+            can_step = jnp.any(en, axis=1)
+            nxt = jax.tree.map(lambda a: a[jnp.arange(B), choice], cands)
+            nrows = jax.vmap(flatten_state, (0, None))(nxt, dims)
+
+            if inv_fns:
+                inv = jax.vmap(inv_id)(nxt)
+            else:
+                inv = jnp.full((B,), -1, _I32)
+            bad = can_step & (inv >= 0)
+            vf, vinv, vroot, vlen, vacts, vchoice = latch
+            any_new = jnp.any(bad) & ~vf
+            w = jnp.argmax(bad)
+            latch = (vf | jnp.any(bad),
+                     jnp.where(any_new, inv[w], vinv),
+                     jnp.where(any_new, cur_root[w], vroot),
+                     jnp.where(any_new, tstep[w], vlen),
+                     jnp.where(any_new, abuf[w], vacts),
+                     jnp.where(any_new, choice[w].astype(_I32), vchoice))
+
+            if constraint is not None:
+                cons_ok = jax.vmap(constraint)(nxt)
+            else:
+                cons_ok = jnp.ones((B,), bool)
+            # Record the action taken since the last restart.
+            abuf = abuf.at[jnp.arange(B),
+                           jnp.clip(tstep, 0, D - 1)].set(
+                jnp.where(can_step, choice.astype(_I32), -1))
+            # Restart on: dead end, overflow, constraint stop, depth bound.
+            restart = (~can_step | jnp.any(ovf, axis=1) | ~cons_ok
+                       | (tstep + 1 >= D))
+            root_idx = jax.random.randint(jax.random.fold_in(key, 1),
+                                          (B,), 0, roots.shape[0])
+            rows = jnp.where(restart[:, None], roots[root_idx],
+                             jnp.where(can_step[:, None], nrows, rows))
+            cur_root = jnp.where(restart, root_idx.astype(_I32), cur_root)
+            tstep = jnp.where(restart, 0, tstep + 1)
+            restarts = restarts + jnp.sum(restart, dtype=_I32)
+            return (rows, roots, tstep, cur_root, abuf, restarts,
+                    latch), None
+
+        def chunk_fn(rows, roots, tstep, cur_root, abuf, key):
+            keys = jax.random.split(key, self.chunk)
+            latch0 = (jnp.bool_(False), jnp.int32(-1), jnp.int32(0),
+                      jnp.int32(0), jnp.zeros((D,), _I32), jnp.int32(-1))
+            carry0 = (rows, roots, tstep, cur_root, abuf,
+                      jnp.int32(0), latch0)
+            carry, _ = jax.lax.scan(body, carry0, keys)
+            return carry
+
+        self._chunk = jax.jit(chunk_fn, donate_argnums=(0, 4))
+        self._expand1 = jax.jit(expand)
+
+    # ------------------------------------------------------------------
+    def run(self, roots: List[PyState], num_steps: int, seed: int = 0,
+            max_seconds: Optional[float] = None) -> SimResult:
+        dims, B, D = self.dims, self.batch, self.depth
+        res = SimResult()
+        t0 = time.time()
+        roots_np = np.stack([
+            flatten_state(encode_state(s, dims), dims) for s in roots])
+        roots_j = jnp.asarray(roots_np)
+        key = jax.random.PRNGKey(seed)
+        key, sub = jax.random.split(key)
+        start = jax.random.randint(sub, (B,), 0, len(roots)).astype(_I32)
+        rows = roots_j[start]
+        cur_root = start
+        tstep = jnp.zeros((B,), _I32)
+        abuf = jnp.zeros((B, D), _I32)
+        res.traces = B
+
+        while res.steps < num_steps:
+            key, sub = jax.random.split(key)
+            carry = self._chunk(rows, roots_j, tstep, cur_root, abuf, sub)
+            rows, _roots, tstep, cur_root, abuf, restarts, latch = carry
+            res.steps += B * self.chunk
+            res.traces += int(restarts)
+            vf, vinv, vroot, vlen, vacts, vchoice = latch
+            if bool(vf):
+                self._reconstruct(res, roots, int(vinv), int(vroot),
+                                  int(vlen), np.asarray(vacts),
+                                  int(vchoice))
+                break
+            if max_seconds is not None and time.time() - t0 > max_seconds:
+                break
+        res.wall_seconds = time.time() - t0
+        return res
+
+    # ------------------------------------------------------------------
+    def _reconstruct(self, res: SimResult, roots, vinv, vroot, vlen,
+                     vacts, vchoice):
+        """Replay the latched (root, action sequence) through the kernels."""
+        state = roots[vroot]
+        trace = [(-1, state)]
+        for g in list(vacts[:vlen]) + [vchoice]:
+            g = int(g)
+            st = encode_state(state, self.dims)
+            cands, en, _ovf = self._expand1(st)
+            if g < 0 or not bool(np.asarray(en)[g]):
+                break
+            row = jax.tree.map(lambda a: np.asarray(a)[g], cands)
+            state = decode_state(StateBatch(*row), self.dims)
+            trace.append((g, state))
+        res.violation_state = state
+        res.violation_trace = trace
+        res.violation_invariant = (self.inv_names[vinv]
+                                   if 0 <= vinv < len(self.inv_names)
+                                   else "?")

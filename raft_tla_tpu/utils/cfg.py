@@ -1,0 +1,271 @@
+"""TLC ``.cfg`` configuration parsing and model resolution.
+
+The reference harness configs (/root/reference/MCraft.cfg,
+/root/reference/Smokeraft.cfg) remain the source of truth (SURVEY §5.6/H1-H2):
+this module parses the TLC cfg grammar subset they use —
+
+    CONSTANT/CONSTANTS blocks with ``name = modelvalue``,
+    ``name = {set literal}``, ``name = number``, and ``name <- definition``
+    substitutions; SPECIFICATION; INVARIANT(S); CONSTRAINT(S);
+    CHECK_DEADLOCK; ``\\*`` comments
+
+— and resolves them against the spec's known definition names.  Instead of a
+full TLA+ parser, the companion ``.tla`` harness module (MCraft.tla /
+Smokeraft.tla, looked up next to the cfg) is scanned for the three shapes the
+harnesses actually use:
+
+- model-value set definitions ``name == {v1, v2}`` (MCraft.tla:15-21),
+- the smoke subset size ``k == 2`` (Smokeraft.tla:17-19),
+- StopAfter budgets ``TLCGet("duration") > 1`` / ``TLCGet("diameter") > 100``
+  (Smokeraft.tla:88-92).
+
+Bounded exhaustive configs (the BASELINE.json runs) use ordinary cfg constants
+``MaxTerm/MaxLogLen/MaxMsgCount`` consumed by the built-in ``BoundedSpace``
+constraint — standard TLC practice, no grammar extension required.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import re
+from typing import Dict, List, Optional, Tuple
+
+from ..models.dims import RaftDims
+from ..models.invariants import Bounds
+
+_KEYWORDS = {
+    "CONSTANT", "CONSTANTS", "SPECIFICATION", "INVARIANT", "INVARIANTS",
+    "CONSTRAINT", "CONSTRAINTS", "ACTION_CONSTRAINT", "INIT", "NEXT",
+    "SYMMETRY", "VIEW", "CHECK_DEADLOCK", "PROPERTY", "PROPERTIES",
+}
+
+
+@dataclasses.dataclass
+class ParsedCfg:
+    assignments: Dict[str, object] = dataclasses.field(default_factory=dict)
+    substitutions: Dict[str, str] = dataclasses.field(default_factory=dict)
+    specification: Optional[str] = None
+    init: Optional[str] = None
+    next: Optional[str] = None
+    invariants: List[str] = dataclasses.field(default_factory=list)
+    constraints: List[str] = dataclasses.field(default_factory=list)
+    action_constraints: List[str] = dataclasses.field(default_factory=list)
+    properties: List[str] = dataclasses.field(default_factory=list)
+    check_deadlock: bool = True        # TLC default
+
+
+def _tokenize(text: str) -> List[str]:
+    text = re.sub(r"\\\*[^\n]*", " ", text)          # \* line comments
+    text = re.sub(r"\(\*.*?\*\)", " ", text, flags=re.S)  # (* block *)
+    # Split keeping braces/commas/operators as tokens.
+    return re.findall(r"<-|=|\{|\}|,|[^\s{},=]+", text)
+
+
+def parse_cfg(text: str) -> ParsedCfg:
+    toks = _tokenize(text)
+    cfg = ParsedCfg()
+    i, n = 0, len(toks)
+
+    def parse_value(j: int) -> Tuple[object, int]:
+        if toks[j] == "{":
+            vals, j = [], j + 1
+            while toks[j] != "}":
+                if toks[j] != ",":
+                    vals.append(toks[j])
+                j += 1
+            return tuple(vals), j + 1
+        v = toks[j]
+        if re.fullmatch(r"-?\d+", v):
+            return int(v), j + 1
+        if v in ("TRUE", "FALSE"):
+            return v == "TRUE", j + 1
+        return v, j + 1
+
+    mode = None
+    while i < n:
+        t = toks[i]
+        if t in _KEYWORDS:
+            mode = t
+            i += 1
+            if t == "CHECK_DEADLOCK":
+                cfg.check_deadlock = toks[i] == "TRUE"
+                i += 1
+                mode = None
+            continue
+        if mode in ("CONSTANT", "CONSTANTS", "INIT", "NEXT"):
+            # INIT/NEXT in cfg name an operator; `Init <- SmokeInit` appears
+            # inside a CONSTANT block in Smokeraft.cfg:43-44 — both accepted.
+            name = t
+            if i + 1 < n and toks[i + 1] == "=":
+                val, i2 = parse_value(i + 2)
+                cfg.assignments[name] = val
+                i = i2
+            elif i + 1 < n and toks[i + 1] == "<-":
+                cfg.substitutions[name] = toks[i + 2]
+                i += 3
+            elif mode in ("INIT", "NEXT"):
+                setattr(cfg, mode.lower(), name)
+                i += 1
+                mode = None
+            else:
+                i += 1
+        elif mode == "SPECIFICATION":
+            cfg.specification = t
+            i += 1
+            mode = None
+        elif mode in ("INVARIANT", "INVARIANTS"):
+            cfg.invariants.append(t)
+            i += 1
+        elif mode in ("CONSTRAINT", "CONSTRAINTS"):
+            cfg.constraints.append(t)
+            i += 1
+        elif mode == "ACTION_CONSTRAINT":
+            # TLC action constraints range over transitions (primed and
+            # unprimed state) — different semantics from state constraints;
+            # rejected explicitly rather than silently misinterpreted.
+            cfg.action_constraints.append(t)
+            i += 1
+        elif mode in ("PROPERTY", "PROPERTIES"):
+            cfg.properties.append(t)
+            i += 1
+        else:
+            i += 1
+    return cfg
+
+
+# ---------------------------------------------------------------------------
+# Companion-module scanning (the three shapes the reference harnesses use).
+
+def scan_module_definitions(text: str) -> Dict[str, object]:
+    """Extract ``name == <set literal | int>`` definitions from a harness
+    module (handles the newline between name and body, MCraft.tla:15-21)."""
+    out: Dict[str, object] = {}
+    for m in re.finditer(
+            r"^\s*(\w+)\s*==\s*\n?\s*(\{[^}]*\}|-?\d+)\s*$",
+            re.sub(r"\\\*[^\n]*", "", text), flags=re.M):
+        name, body = m.group(1), m.group(2).strip()
+        if body.startswith("{"):
+            out[name] = tuple(x.strip() for x in body[1:-1].split(",")
+                              if x.strip())
+        else:
+            out[name] = int(body)
+    return out
+
+
+def scan_stop_after(text: str) -> Tuple[Optional[float], Optional[int]]:
+    """StopAfter budgets from TLCGet patterns (Smokeraft.tla:88-92)."""
+    dur = re.search(r'TLCGet\("duration"\)\s*>\s*(\d+)', text)
+    dia = re.search(r'TLCGet\("diameter"\)\s*>\s*(\d+)', text)
+    return (float(dur.group(1)) if dur else None,
+            int(dia.group(1)) if dia else None)
+
+
+# ---------------------------------------------------------------------------
+# Resolution into a runnable setup.
+
+@dataclasses.dataclass
+class CheckSetup:
+    """Everything the engine needs, resolved from one cfg."""
+
+    dims: RaftDims
+    bounds: Bounds
+    invariants: List[str]
+    constraints: List[str]
+    check_deadlock: bool
+    smoke: bool = False                 # Init <- SmokeInit override
+    smoke_k: int = 2
+    max_seconds: Optional[float] = None
+    max_diameter: Optional[int] = None
+    server_names: Tuple[str, ...] = ()
+    value_names: Tuple[str, ...] = ()
+    cfg: Optional[ParsedCfg] = None
+
+
+def load_config(cfg_path: str, max_log: Optional[int] = None,
+                n_msg_slots: int = 32) -> CheckSetup:
+    """Parse cfg + companion module, intern model values, derive dims."""
+    with open(cfg_path) as f:
+        cfg = parse_cfg(f.read())
+    moddefs: Dict[str, object] = {}
+    stop_dur = stop_dia = None
+    # Scan the companion module and its EXTENDS chain (Smokeraft EXTENDS
+    # MCraft — Smokeraft.tla:2 — whose const_* definitions the cfg names).
+    mod_dir = os.path.dirname(os.path.abspath(cfg_path))
+    pending = [os.path.splitext(os.path.basename(cfg_path))[0]]
+    seen_mods = set()
+    while pending:
+        mod = pending.pop()
+        if mod in seen_mods:
+            continue
+        seen_mods.add(mod)
+        cand = os.path.join(mod_dir, mod + ".tla")
+        if not os.path.exists(cand):
+            continue
+        with open(cand) as f:
+            text = f.read()
+        moddefs.update(scan_module_definitions(text))
+        d, di = scan_stop_after(text)
+        stop_dur = stop_dur if d is None else d
+        stop_dia = stop_dia if di is None else di
+        ext = re.search(r"^\s*EXTENDS\s+([^\n]+)", text, flags=re.M)
+        if ext:
+            pending.extend(x.strip() for x in ext.group(1).split(","))
+
+    def resolve_set(name: str) -> Tuple[str, ...]:
+        if name in cfg.assignments and isinstance(cfg.assignments[name],
+                                                  tuple):
+            return cfg.assignments[name]
+        if name in cfg.substitutions:
+            target = cfg.substitutions[name]
+            if target in moddefs and isinstance(moddefs[target], tuple):
+                return moddefs[target]
+            raise ValueError(
+                f"cannot resolve {name} <- {target}: definition not found "
+                f"in companion module of {cfg_path}")
+        raise ValueError(f"no binding for constant {name} in {cfg_path}")
+
+    servers = resolve_set("Server")
+    values = resolve_set("Value")
+
+    def int_const(name: str) -> Optional[int]:
+        v = cfg.assignments.get(name)
+        return v if isinstance(v, int) else None
+
+    bounds = Bounds(max_term=int_const("MaxTerm"),
+                    max_log_len=int_const("MaxLogLen"),
+                    max_msg_count=int_const("MaxMsgCount"))
+
+    if cfg.action_constraints:
+        raise NotImplementedError(
+            f"ACTION_CONSTRAINT {cfg.action_constraints} not supported: "
+            "action constraints range over transitions, not states")
+
+    smoke = cfg.substitutions.get("Init") == "SmokeInit" \
+        or cfg.init == "SmokeInit"
+    smoke_k = moddefs.get("k", 2) if smoke else 2
+
+    if max_log is None:
+        if bounds.max_log_len is not None:
+            # Expanded states have len <= MaxLogLen; their successors can
+            # exceed the bound by one appended entry (counted, not expanded).
+            max_log = bounds.max_log_len + 1
+        elif smoke:
+            max_log = 12    # init logs <= 3 (Smokeraft.tla:70) + headroom
+        else:
+            max_log = 8
+
+    max_seconds = max_diameter = None
+    if "StopAfter" in cfg.constraints:
+        max_seconds, max_diameter = stop_dur, stop_dia
+
+    return CheckSetup(
+        dims=RaftDims(n_servers=len(servers), n_values=len(values),
+                      max_log=max_log, n_msg_slots=n_msg_slots),
+        bounds=bounds,
+        invariants=list(cfg.invariants),
+        constraints=[c for c in cfg.constraints if c != "StopAfter"],
+        check_deadlock=cfg.check_deadlock,
+        smoke=smoke, smoke_k=smoke_k,
+        max_seconds=max_seconds, max_diameter=max_diameter,
+        server_names=servers, value_names=values, cfg=cfg)

@@ -1,0 +1,67 @@
+"""cfg-parser tests: the reference configs are the source of truth."""
+
+import os
+
+import pytest
+
+from raft_tla_tpu.utils.cfg import (load_config, parse_cfg,
+                                    scan_module_definitions,
+                                    scan_stop_after)
+
+REF = "/root/reference"
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_parse_mcraft_cfg():
+    s = load_config(f"{REF}/MCraft.cfg")
+    assert s.dims.n_servers == 3 and s.dims.n_values == 2
+    assert s.server_names == ("r1", "r2", "r3")
+    assert s.value_names == ("v1", "v2")
+    assert s.invariants == ["TypeOK"]
+    assert s.constraints == [] and not s.smoke
+    assert s.check_deadlock            # TLC default: on
+    assert s.bounds.max_term is None   # MCraft.cfg is unbounded
+
+
+def test_parse_smokeraft_cfg():
+    s = load_config(f"{REF}/Smokeraft.cfg")
+    assert s.dims.n_servers == 3 and s.dims.n_values == 2
+    assert s.smoke and s.smoke_k == 2          # Smokeraft.tla:17-19
+    assert s.max_seconds == 1.0                # TLCGet("duration") > 1
+    assert s.max_diameter == 100               # TLCGet("diameter") > 100
+    assert not s.check_deadlock                # Smokeraft.cfg:48
+    assert "StopAfter" not in s.constraints    # consumed into budgets
+
+
+def test_parse_bounded_config():
+    s = load_config(os.path.join(REPO, "configs/MCraft_bounded.cfg"))
+    assert s.dims.n_servers == 3 and s.dims.n_values == 2
+    assert (s.bounds.max_term, s.bounds.max_log_len,
+            s.bounds.max_msg_count) == (3, 2, 1)
+    assert s.constraints == ["BoundedSpace"]
+    assert s.dims.max_log == 3     # MaxLogLen + 1 append headroom
+
+
+def test_parse_raft5_config():
+    s = load_config(os.path.join(REPO, "configs/raft5_bounded.cfg"))
+    assert s.dims.n_servers == 5
+    assert s.bounds.max_term == 4 and s.bounds.max_log_len == 4
+
+
+def test_module_definition_scan():
+    text = "foo == \n{a, b}\nk ==\n   2\nbar == {x}\n"
+    d = scan_module_definitions(text)
+    assert d == {"foo": ("a", "b"), "k": 2, "bar": ("x",)}
+
+
+def test_stop_after_scan():
+    text = ('StopAfter ==\n  \\/ TLCSet("exit", TLCGet("duration") > 7)\n'
+            '  \\/ TLCSet("exit", TLCGet("diameter") > 42)\n')
+    assert scan_stop_after(text) == (7.0, 42)
+
+
+def test_unknown_constant_raises(tmp_path):
+    cfgf = tmp_path / "broken.cfg"
+    cfgf.write_text("CONSTANT Value = {v1}\nSPECIFICATION Spec\n")
+    with pytest.raises(ValueError, match="Server"):
+        load_config(str(cfgf))
