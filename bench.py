@@ -1,0 +1,87 @@
+#!/usr/bin/env python3
+"""Benchmark: distinct states/sec on the bounded 3-server MCraft model.
+
+Runs the exhaustive BFS engine on ``configs/MCraft_bounded.cfg`` (MaxTerm=3,
+MaxLogLen=2, MaxMsgCount=1 — BASELINE.json configs[1]) for a fixed wall
+budget on the ambient jax platform (the real TPU chip under the driver;
+falls back to CPU if no accelerator initializes), then prints ONE JSON line.
+
+Baseline note: this environment has no Java, so real CPU TLC cannot be
+measured here (BASELINE.md §b).  The recorded ``vs_baseline`` is the ratio
+against the pure-Python oracle checker measured in the same process — an
+interpreted explicit-state checker on this host's single CPU core, i.e. a
+*conservative stand-in* for TLC (TLC's compiled Java evaluator is roughly
+an order of magnitude faster than the Python oracle; both numbers are
+reported so the comparison can be re-based when a TLC measurement exists).
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+BENCH_SECONDS = float(os.environ.get("BENCH_SECONDS", "45"))
+ORACLE_SECONDS = float(os.environ.get("BENCH_ORACLE_SECONDS", "5"))
+
+
+def main():
+    import jax
+
+    platform = None
+    try:
+        platform = jax.devices()[0].platform
+    except RuntimeError:
+        from raft_tla_tpu.utils.platform import force_cpu
+        force_cpu()
+        platform = jax.devices()[0].platform
+
+    on_accel = platform not in ("cpu",)
+    from raft_tla_tpu.engine.bfs import EngineConfig
+    from raft_tla_tpu.engine.check import initial_states, make_engine
+    from raft_tla_tpu.utils.cfg import load_config
+
+    here = os.path.dirname(os.path.abspath(__file__))
+    setup = load_config(os.path.join(here, "configs/MCraft_bounded.cfg"))
+    cfg = EngineConfig(
+        batch=2048 if on_accel else 128,
+        queue_capacity=1 << (20 if on_accel else 15),
+        seen_capacity=1 << (23 if on_accel else 18),
+        check_deadlock=False,
+        record_trace=False,          # raw engine throughput (trace store is
+        max_seconds=BENCH_SECONDS)   # host-side; C++ store tracked separately)
+    engine = make_engine(setup, cfg)
+    res = engine.run(initial_states(setup))
+    rate = res.distinct / res.wall_seconds if res.wall_seconds else 0.0
+
+    # Python-oracle baseline on the same model (CPU, single core).
+    from raft_tla_tpu.models import oracle as orc
+    from raft_tla_tpu.models.invariants import constraint_py
+    from raft_tla_tpu.models.pystate import init_state
+
+    t0 = time.time()
+    ores = orc.bfs([init_state(setup.dims)], setup.dims,
+                   constraint=constraint_py(setup.bounds),
+                   check_deadlock=False,
+                   stop_predicate=lambda r: time.time() - t0 > ORACLE_SECONDS)
+    base_wall = time.time() - t0
+    base_rate = ores.distinct_states / base_wall if base_wall else 1.0
+
+    print(json.dumps({
+        "metric": "distinct_states_per_sec",
+        "value": round(rate, 1),
+        "unit": "states/s",
+        "vs_baseline": round(rate / base_rate, 2) if base_rate else None,
+        "platform": platform,
+        "distinct_states": res.distinct,
+        "wall_s": round(res.wall_seconds, 2),
+        "diameter": res.diameter,
+        "stop_reason": res.stop_reason,
+        "baseline_states_per_sec": round(base_rate, 1),
+        "baseline_kind": "python-oracle-1core (no TLC/java available)",
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -17,15 +17,13 @@ import sys
 
 
 def _force_platform(platform: str):
+    if platform == "cpu":
+        from .utils.platform import force_cpu
+        force_cpu()
+        return
     os.environ["JAX_PLATFORMS"] = platform
     import jax
     jax.config.update("jax_platforms", platform)
-    if platform == "cpu":
-        try:
-            from jax._src import xla_bridge
-            xla_bridge._backend_factories.pop("axon", None)
-        except Exception:
-            pass
 
 
 def main(argv=None):
@@ -82,12 +80,16 @@ def main(argv=None):
         res = engine.run(initial_states(setup, seed=args.seed))
         print(format_result(res))
         if res.violation is not None:
-            print("\ncounterexample trace:")
-            for g, st in engine.replay(res.violation.fingerprint):
-                label = ("Initial state" if g < 0
-                         else setup.dims.describe_instance(g))
-                print(f"-- {label}")
-                print(format_state(st, setup.dims))
+            if args.no_trace:
+                print("\nviolating state (trace recording disabled):")
+                print(format_state(res.violation.state, setup.dims))
+            else:
+                print("\ncounterexample trace:")
+                for g, st in engine.replay(res.violation.fingerprint):
+                    label = ("Initial state" if g < 0
+                             else setup.dims.describe_instance(g))
+                    print(f"-- {label}")
+                    print(format_state(st, setup.dims))
             return 1
         if res.deadlock is not None:
             print("\ndeadlock state:")

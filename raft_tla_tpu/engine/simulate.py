@@ -127,7 +127,14 @@ class Simulator:
             carry, _ = jax.lax.scan(body, carry0, keys)
             return carry
 
+        def roots_inv(rows):
+            states = jax.vmap(unflatten_state, (0, None))(rows, dims)
+            if inv_fns:
+                return jax.vmap(inv_id)(states)
+            return jnp.full(rows.shape[:1], -1, _I32)
+
         self._chunk = jax.jit(chunk_fn, donate_argnums=(0, 4))
+        self._roots_inv = jax.jit(roots_inv)
         self._expand1 = jax.jit(expand)
 
     # ------------------------------------------------------------------
@@ -139,6 +146,16 @@ class Simulator:
         roots_np = np.stack([
             flatten_state(encode_state(s, dims), dims) for s in roots])
         roots_j = jnp.asarray(roots_np)
+        # TLC checks invariants on initial states too (so does the BFS
+        # engine's ingest path); a violating root ends the run immediately.
+        rinv = np.asarray(self._roots_inv(roots_j))
+        if (rinv >= 0).any():
+            idx = int(np.argmax(rinv >= 0))
+            res.violation_state = roots[idx]
+            res.violation_trace = [(-1, roots[idx])]
+            res.violation_invariant = self.inv_names[int(rinv[idx])]
+            res.wall_seconds = time.time() - t0
+            return res
         key = jax.random.PRNGKey(seed)
         key, sub = jax.random.split(key)
         start = jax.random.randint(sub, (B,), 0, len(roots)).astype(_I32)

@@ -1,0 +1,371 @@
+"""Mesh-sharded BFS — distributed TLC over a jax device mesh.
+
+TLC scales with a multi-threaded worker pool and an RMI-based distributed
+mode [TLC semantics — external; SURVEY §2.4 R7].  The TPU-native equivalent
+shards the level-synchronous BFS over a 1-D ``jax.sharding.Mesh`` with
+``shard_map``; collectives ride ICI (and DCN across hosts, transparently —
+the program is identical):
+
+- the frontier queue, next-level queue, and FPSet are sharded per chip;
+- each chip expands its local batch and fingerprints its candidates;
+- **fingerprint-owner dedup**: candidate fps are routed to their owner chip
+  (``fp_hi mod n``) with one ``all_to_all``; the owner runs the same
+  sort-dedup + sorted-set probe/merge as the single-chip engine on the
+  union of arriving queries, then a reverse ``all_to_all`` returns one
+  novelty bit per query.  Exactly one copy of each globally-new state gets
+  the bit, so states enqueue on the chip that *generated* them — only
+  8-byte fingerprints ever cross the interconnect, never state rows;
+- stats (new/generated/overflow/deadlock/violation) combine with ``psum``.
+
+The host loop mirrors engine/bfs.py: offsets advance in lockstep batches
+(chips with short local queues mask out), queues swap per level, scalars and
+compacted trace records stream back per step.
+
+Tested on a virtual 8-device CPU mesh (SURVEY §4.5); the program is
+identical on a real TPU slice.
+"""
+
+from __future__ import annotations
+
+import time
+from functools import partial
+from typing import Callable, Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, PartitionSpec as P
+
+from ..engine.bfs import (EngineConfig, EngineResult, TraceStore, Violation)
+from ..models.actions import build_expand
+from ..models.dims import RaftDims
+from ..models.pystate import PyState
+from ..models.schema import (decode_state, encode_state, flatten_state,
+                             state_width, unflatten_state)
+from ..ops import fpset
+from ..ops.fingerprint import SENTINEL, build_fingerprint
+
+_I32 = jnp.int32
+_U32 = jnp.uint32
+
+
+class MeshBFSEngine:
+    """Exhaustive checker sharded over an n-device mesh."""
+
+    def __init__(self, dims: RaftDims,
+                 invariants: Optional[Dict[str, Callable]] = None,
+                 constraint: Optional[Callable] = None,
+                 config: Optional[EngineConfig] = None,
+                 devices=None):
+        self.dims = dims
+        self.config = config or EngineConfig()
+        cfg = self.config
+        devices = devices if devices is not None else jax.devices()
+        self.n_dev = n = len(devices)
+        self.mesh = Mesh(np.asarray(devices), ("x",))
+        self.inv_names = list((invariants or {}).keys())
+        inv_fns = list((invariants or {}).values())
+        expand = build_expand(dims)
+        fingerprint = build_fingerprint(dims)
+        sw = state_width(dims)
+        B, G = cfg.batch, dims.n_instances
+        K = B * G
+        # Per-chip capacities.
+        QL = max(B, (-(-cfg.queue_capacity // n) // B) * B)
+        CL = -(-cfg.seen_capacity // n)
+        self._sw, self._B, self._QL, self._CL = sw, B, QL, CL
+
+        def local_absorb(crows, cands, en, parent_hi, parent_lo, actions,
+                         qnext, next_count, shi, slo, ssize):
+            """Per-chip tail with cross-chip owner dedup.  All arrays are
+            this chip's shard (no leading device axis)."""
+            k = crows.shape[0]
+            fph, fpl = jax.vmap(fingerprint)(cands)
+            fph = jnp.where(en, fph, SENTINEL)
+            fpl = jnp.where(en, fpl, SENTINEL)
+
+            # Route to owner = fp_hi mod n.
+            owner = (fph % _U32(n)).astype(_I32)
+            perm = jnp.argsort(owner, stable=True)
+            osort = owner[perm]
+            q_hi, q_lo = fph[perm], fpl[perm]
+            block_start = jnp.searchsorted(osort, jnp.arange(n, dtype=_I32))
+            rank = jnp.arange(k, dtype=_I32) - block_start[osort]
+            bh = jnp.full((n, k), SENTINEL, _U32).at[osort, rank].set(q_hi)
+            bl = jnp.full((n, k), SENTINEL, _U32).at[osort, rank].set(q_lo)
+            bh = jax.lax.all_to_all(bh, "x", 0, 0, tiled=True)
+            bl = jax.lax.all_to_all(bl, "x", 0, 0, tiled=True)
+
+            # Owner side: dedup the union of arriving queries, probe, merge.
+            rh, rl = bh.reshape(-1), bl.reshape(-1)
+            rvalid = ~((rh == SENTINEL) & (rl == SENTINEL))
+            (qsh, qsl), qorder, qfirst = fpset.dedup_batch(rh, rl, rvalid)
+            seen_local = fpset.FPSet(hi=shi, lo=slo, size=ssize)
+            qnew = qfirst & ~fpset.contains(seen_local, qsh, qsl)
+            seen_local = fpset.merge(seen_local, qsh, qsl, qnew)
+            nov = jnp.zeros((n * k,), bool).at[qorder].set(qnew)
+            nov = jax.lax.all_to_all(nov.reshape(n, k), "x", 0, 0,
+                                     tiled=True)
+            # Back on the origin chip: one novelty bit per local candidate.
+            new_sortpos = nov[osort, rank]
+            new = jnp.zeros((k,), bool).at[perm].set(new_sortpos)
+
+            n_new = jnp.sum(new, dtype=_I32)      # local share of global new
+
+            if inv_fns:
+                def inv_id(st):
+                    out = jnp.int32(-1)
+                    for q in range(len(inv_fns) - 1, -1, -1):
+                        out = jnp.where(inv_fns[q](st), out, jnp.int32(q))
+                    return out
+                inv = jax.vmap(inv_id)(cands)
+            else:
+                inv = jnp.full((k,), -1, _I32)
+            viol = new & (inv >= 0)
+            viol_any = jnp.any(viol)
+            vpos = jnp.argmax(viol)
+
+            if constraint is not None:
+                cons_ok = jax.vmap(constraint)(cands)
+            else:
+                cons_ok = jnp.ones((k,), bool)
+            enq = new & cons_ok
+            pos = next_count + jnp.cumsum(enq.astype(_I32)) - 1
+            pos = jnp.where(enq, pos, QL)
+            qnext = qnext.at[pos].set(crows, mode="drop")
+            next_count = next_count + jnp.sum(enq, dtype=_I32)
+
+            tpos = jnp.where(new, jnp.cumsum(new.astype(_I32)) - 1, k)
+
+            def compact(x):
+                return jnp.zeros((k,), x.dtype).at[tpos].set(x, mode="drop")
+
+            tr = (compact(fph), compact(fpl), compact(parent_hi),
+                  compact(parent_lo), compact(actions))
+            vinfo = (viol_any, inv[vpos], crows[vpos], fph[vpos], fpl[vpos])
+            return (qnext, next_count, seen_local.hi, seen_local.lo,
+                    seen_local.size, n_new, tr, vinfo)
+
+        def sharded_step(qcur, cur_count, offset, qnext, next_count,
+                         shi, slo, ssize):
+            # Shapes inside shard_map: qcur [1,QL,SW], counts [1], etc.
+            qcur_l, qnext_l = qcur[0], qnext[0]
+            cnt_l, ncnt_l = cur_count[0], next_count[0]
+            shi_l, slo_l, ssz_l = shi[0], slo[0], ssize[0]
+            rows = jax.lax.dynamic_slice_in_dim(qcur_l, offset, B, axis=0)
+            valid = (offset + jnp.arange(B, dtype=_I32)) < cnt_l
+            states = jax.vmap(unflatten_state, (0, None))(rows, dims)
+            cands, en, ovf = jax.vmap(expand)(states)
+            en = en & valid[:, None]
+            ovf = ovf & valid[:, None]
+            dead = valid & ~jnp.any(en, axis=1) & ~jnp.any(ovf, axis=1)
+            dead_any = jnp.any(dead)
+            drow = rows[jnp.argmax(dead)]
+
+            cflat = jax.tree.map(
+                lambda a: a.reshape((K,) + a.shape[2:]), cands)
+            crows = jax.vmap(flatten_state, (0, None))(cflat, dims)
+            php, plp = jax.vmap(fingerprint)(states)
+            k_idx = jnp.arange(K, dtype=_I32)
+            (qnext_l, ncnt_l, shi_l, slo_l, ssz_l, n_new, tr,
+             vinfo) = local_absorb(
+                crows, cflat, en.reshape(-1), php[k_idx // G],
+                plp[k_idx // G], k_idx % G, qnext_l, ncnt_l,
+                shi_l, slo_l, ssz_l)
+            g_new = jax.lax.psum(n_new, "x")
+            g_gen = jax.lax.psum(jnp.sum(en, dtype=_I32), "x")
+            g_ovf = jax.lax.psum(jnp.sum(ovf, dtype=_I32), "x")
+            stats = (g_new[None], g_gen[None], g_ovf[None], dead_any[None])
+            return (qnext_l[None], ncnt_l[None], shi_l[None], slo_l[None],
+                    ssz_l[None], stats,
+                    tuple(x[None] for x in tr),
+                    tuple(jnp.asarray(x)[None] for x in vinfo),
+                    drow[None], n_new[None])
+
+        def sharded_ingest(rows, valid, qnext, next_count, shi, slo, ssize):
+            rows_l, valid_l = rows[0], valid[0]
+            states = jax.vmap(unflatten_state, (0, None))(rows_l, dims)
+            sent = jnp.zeros(rows_l.shape[:1], _U32)
+            acts = jnp.full(rows_l.shape[:1], -1, _I32)
+            (qnext_l, ncnt_l, shi_l, slo_l, ssz_l, n_new, tr,
+             vinfo) = local_absorb(
+                rows_l, states, valid_l, sent, sent, acts,
+                qnext[0], next_count[0], shi[0], slo[0], ssize[0])
+            g_new = jax.lax.psum(n_new, "x")
+            return (qnext_l[None], ncnt_l[None], shi_l[None], slo_l[None],
+                    ssz_l[None], g_new[None],
+                    tuple(x[None] for x in tr),
+                    tuple(jnp.asarray(x)[None] for x in vinfo),
+                    n_new[None])
+
+        shard = partial(jax.shard_map, mesh=self.mesh, check_vma=False)
+        sx = P("x")
+        rep = P()
+        self._step = jax.jit(shard(
+            sharded_step,
+            in_specs=(sx, sx, rep, sx, sx, sx, sx, sx),
+            out_specs=(sx, sx, sx, sx, sx,
+                       (sx, sx, sx, sx), (sx,) * 5, (sx,) * 5, sx, sx)),
+            donate_argnums=(3, 5, 6))
+        self._ingest = jax.jit(shard(
+            sharded_ingest,
+            in_specs=(sx, sx, sx, sx, sx, sx, sx),
+            out_specs=(sx, sx, sx, sx, sx, sx, (sx,) * 5, (sx,) * 5, sx)),
+            donate_argnums=(2, 4, 5))
+
+        def fp_rows(rows):
+            return jax.vmap(fingerprint)(
+                jax.vmap(unflatten_state, (0, None))(rows, dims))
+
+        self._fp_rows = jax.jit(fp_rows)
+        self._expand1 = jax.jit(expand)
+
+    # ------------------------------------------------------------------
+    def run(self, init_states: List[PyState]) -> EngineResult:
+        dims, cfg = self.dims, self.config
+        n, sw, B, QL, CL = self.n_dev, self._sw, self._B, self._QL, self._CL
+        res = EngineResult()
+        trace = TraceStore()
+        self.trace = trace
+
+        qcur = jnp.zeros((n, QL, sw), _I32)
+        qnext = jnp.zeros((n, QL, sw), _I32)
+        shi = jnp.full((n, CL), SENTINEL, _U32)
+        slo = jnp.full((n, CL), SENTINEL, _U32)
+        ssize = jnp.zeros((n,), _I32)
+        next_counts = jnp.zeros((n,), _I32)
+
+        rows_np = np.stack([
+            flatten_state(encode_state(s, dims), dims) for s in init_states])
+        if cfg.record_trace:
+            rhi, rlo = (np.asarray(x) for x in
+                        self._fp_rows(jnp.asarray(rows_np)))
+            for idx, s in enumerate(init_states):
+                trace.roots.setdefault(
+                    (int(rhi[idx]) << 32) | int(rlo[idx]), s)
+
+        # Warm-up compilation before the duration clock starts.
+        out = self._ingest(jnp.zeros((n, B, sw), _I32),
+                           jnp.zeros((n, B), bool),
+                           qnext, next_counts, shi, slo, ssize)
+        qnext, next_counts, shi, slo, ssize = out[:5]
+        out = self._step(qcur, jnp.zeros((n,), _I32), jnp.int32(0),
+                         qnext, next_counts, shi, slo, ssize)
+        qnext, next_counts, shi, slo, ssize = out[:5]
+        t0 = time.time()
+
+        # Ingest roots round-robin across chips in B-sized waves.
+        per_chip = [rows_np[i::n] for i in range(n)]
+        max_chunks = max((-(-len(p) // B) for p in per_chip), default=0)
+        for c in range(max_chunks):
+            wave = np.zeros((n, B, sw), np.int32)
+            valid = np.zeros((n, B), bool)
+            for d in range(n):
+                part = per_chip[d][c * B:(c + 1) * B]
+                wave[d, :len(part)] = part
+                valid[d, :len(part)] = True
+            out = self._ingest(jnp.asarray(wave), jnp.asarray(valid),
+                               qnext, next_counts, shi, slo, ssize)
+            (qnext, next_counts, shi, slo, ssize, g_new, tr, vinfo,
+             l_new) = out
+            res.distinct += int(np.asarray(g_new)[0])
+            self._record(trace, tr, np.asarray(l_new))
+            self._capacity_check(next_counts, ssize)
+            if self._check_violation(res, vinfo):
+                break
+
+        res.levels.append(int(np.asarray(next_counts).sum()))
+        qcur, qnext = qnext, qcur
+        cur_counts = np.asarray(next_counts).copy()
+        next_counts = jnp.zeros((n,), _I32)
+
+        while cur_counts.sum() > 0 and res.violation is None \
+                and res.stop_reason == "exhausted":
+            if cfg.max_diameter is not None \
+                    and res.diameter >= cfg.max_diameter:
+                res.stop_reason = "diameter_budget"
+                break
+            offset = 0
+            max_count = int(cur_counts.max())
+            while offset < max_count:
+                out = self._step(qcur, jnp.asarray(cur_counts, _I32),
+                                 jnp.int32(offset), qnext, next_counts,
+                                 shi, slo, ssize)
+                (qnext, next_counts, shi, slo, ssize, stats, tr, vinfo,
+                 drow, l_new) = out
+                g_new = int(np.asarray(stats[0])[0])
+                g_gen = int(np.asarray(stats[1])[0])
+                g_ovf = int(np.asarray(stats[2])[0])
+                dead = np.asarray(stats[3])
+                if g_ovf:
+                    raise RuntimeError(
+                        f"{g_ovf} successors exceeded fixed-width capacity "
+                        f"(max_log={dims.max_log}, "
+                        f"n_msg_slots={dims.n_msg_slots})")
+                res.distinct += g_new
+                res.generated += g_gen
+                self._record(trace, tr, np.asarray(l_new))
+                self._capacity_check(next_counts, ssize)
+                if self._check_violation(res, vinfo):
+                    break
+                if dead.any() and cfg.check_deadlock:
+                    d = int(np.argmax(dead))
+                    res.deadlock = decode_state(
+                        unflatten_state(np.asarray(drow)[d], dims), dims)
+                    res.stop_reason = "deadlock"
+                    break
+                offset += B
+                if (cfg.max_seconds is not None
+                        and time.time() - t0 > cfg.max_seconds):
+                    res.stop_reason = "duration_budget"
+                    break
+            if res.stop_reason != "exhausted" or res.violation is not None:
+                break
+            res.diameter += 1
+            res.levels.append(int(np.asarray(next_counts).sum()))
+            qcur, qnext = qnext, qcur
+            cur_counts = np.asarray(next_counts).copy()
+            next_counts = jnp.zeros((self.n_dev,), _I32)
+
+        res.wall_seconds = time.time() - t0
+        return res
+
+    # ------------------------------------------------------------------
+    def _capacity_check(self, next_counts, ssize):
+        if int(np.asarray(next_counts).max()) > self._QL:
+            raise RuntimeError("per-chip queue capacity exceeded")
+        if int(np.asarray(ssize).max()) > self._CL:
+            raise RuntimeError("per-chip seen-set capacity exceeded")
+
+    def _record(self, trace, tr, l_new):
+        if not self.config.record_trace:
+            return
+        sh, sl, ph, pl, ac = (np.asarray(x) for x in tr)
+        for d in range(self.n_dev):
+            m = int(l_new[d])
+            if m == 0:
+                continue
+            fps = ((sh[d, :m].astype(np.uint64) << np.uint64(32))
+                   | sl[d, :m].astype(np.uint64))
+            parents = ((ph[d, :m].astype(np.uint64) << np.uint64(32))
+                       | pl[d, :m].astype(np.uint64))
+            trace.add_batch(fps, parents, ac[d, :m])
+
+    def _check_violation(self, res, vinfo) -> bool:
+        viol_any = np.asarray(vinfo[0])
+        if not viol_any.any():
+            return False
+        d = int(np.argmax(viol_any))
+        st = decode_state(
+            unflatten_state(np.asarray(vinfo[2])[d], self.dims), self.dims)
+        fp = (int(np.asarray(vinfo[3])[d]) << 32) | int(np.asarray(vinfo[4])[d])
+        res.violation = Violation(
+            invariant=self.inv_names[int(np.asarray(vinfo[1])[d])],
+            state=st, fingerprint=fp)
+        res.stop_reason = "violation"
+        return True
+
+    # Replay shares the single-engine mechanism.
+    def replay(self, fp: int):
+        from ..engine.bfs import BFSEngine  # reuse logic via duck typing
+        return BFSEngine.replay(self, fp)

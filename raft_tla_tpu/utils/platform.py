@@ -1,0 +1,36 @@
+"""Platform selection helpers — the axon (TPU-tunnel) workaround, once.
+
+This machine's ambient environment force-registers the ``axon`` PJRT plugin
+via sitecustomize and overrides ``jax_platforms`` by config, so requesting
+CPU through environment variables alone is not enough: once registered, any
+backend initialization blocks on the TPU relay.  These helpers put jax back
+on CPU reliably.  They depend on one private jax API
+(``xla_bridge._backend_factories``) — kept in this single module so a jax
+upgrade has exactly one place to fix.
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def force_cpu() -> None:
+    """Pin jax to the CPU backend, deregistering the axon plugin if the
+    sitecustomize hook installed it.  Must run before backend init."""
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    try:
+        from jax._src import xla_bridge
+
+        xla_bridge._backend_factories.pop("axon", None)
+    except Exception:
+        pass
+
+
+def neutralize_axon_if_cpu_requested() -> None:
+    """Apply :func:`force_cpu` only when the environment asks for CPU —
+    leaves real-TPU runs (JAX_PLATFORMS=axon) untouched."""
+    if "cpu" in os.environ.get("JAX_PLATFORMS", ""):
+        force_cpu()

@@ -15,20 +15,18 @@ under the ambient environment, never by the unit suite.
 import os
 import sys
 
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8").strip()
 
-import jax  # noqa: E402
+import jax  # noqa: E402  (imported before force_cpu touches its config)
 
-jax.config.update("jax_platforms", "cpu")
-try:
-    from jax._src import xla_bridge
+from raft_tla_tpu.utils.platform import force_cpu  # noqa: E402
 
-    xla_bridge._backend_factories.pop("axon", None)
-except Exception:  # registry layout varies across jax versions
-    pass
+force_cpu()
 
 # Persistent compilation cache: the expand/step programs take tens of
 # seconds to compile on this single-core CPU; caching makes re-runs cheap.
@@ -39,5 +37,3 @@ try:
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
 except Exception:
     pass
-
-sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

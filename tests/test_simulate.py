@@ -42,3 +42,16 @@ def test_simulation_finds_violation_and_replays():
     assert trace[-1][1] == res.violation_state
     for (g_prev, s_prev), (g, s_next) in zip(trace, trace[1:]):
         assert s_next in orc.successor_set(s_prev, DIMS)
+
+
+def test_simulation_checks_root_states():
+    """TLC checks invariants on initial states; so must simulation mode
+    (e.g. Smokeraft roots can violate TypeOK via negative matchIndex)."""
+    from raft_tla_tpu.models.invariants import build_type_ok
+    bad_root = init_state(DIMS).replace(match_index=((0, -1, 0),) + ((0,) * 3,) * 2)
+    sim = Simulator(DIMS, invariants={"TypeOK": build_type_ok(DIMS)},
+                    batch=8, depth=4, chunk=8)
+    res = sim.run([bad_root], num_steps=64, seed=0)
+    assert res.violation_invariant == "TypeOK"
+    assert res.violation_state == bad_root
+    assert res.violation_trace == [(-1, bad_root)]
