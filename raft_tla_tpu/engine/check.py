@@ -15,14 +15,17 @@ from typing import Callable, Dict, List, Optional
 from ..models import smoke
 from ..models.dims import RaftDims
 from ..models.invariants import Bounds, build_constraint, build_type_ok
+from ..models.safety import SAFETY_INVARIANTS
 from ..models.pystate import PyState, init_state
 from ..utils.cfg import CheckSetup, load_config
 from .bfs import BFSEngine, EngineConfig, EngineResult
 
-# name -> builder(dims) -> kernel(state)->bool.  The dead-region safety
-# invariants (SURVEY §2.3) register here.
+# name -> builder(dims) -> kernel(state)->bool.  TypeOK (raft.tla:482-492)
+# plus the whole dead-region safety suite (raft.tla:896-1180; SURVEY §2.3),
+# checkable by naming them as INVARIANT in any cfg.
 INVARIANT_REGISTRY: Dict[str, Callable[[RaftDims], Callable]] = {
     "TypeOK": build_type_ok,
+    **SAFETY_INVARIANTS,
 }
 
 CONSTRAINT_REGISTRY: Dict[str, Callable[[RaftDims, Bounds], Callable]] = {

@@ -97,6 +97,21 @@ class Bounds:
     max_msg_count: Optional[int] = None  # \A m : messages[m] <= MaxDup
 
 
+def build_inv_id(inv_fns):
+    """First-failing-invariant dispatch shared by the three engines:
+    returns ``inv_id(state) -> int32`` yielding the index of the first
+    violated invariant in ``inv_fns`` order, or -1 when all hold."""
+    import jax.numpy as _jnp
+
+    def inv_id(st: StateBatch):
+        out = _jnp.int32(-1)
+        for q in range(len(inv_fns) - 1, -1, -1):
+            out = _jnp.where(inv_fns[q](st), out, _jnp.int32(q))
+        return out
+
+    return inv_id
+
+
 def build_constraint(dims: RaftDims, bounds: Bounds):
     def constraint(st: StateBatch):
         ok = jnp.bool_(True)

@@ -1,0 +1,150 @@
+"""Differential tests of the correctness-invariant suite (models/safety.py).
+
+Mirrors the reference's proof tier (raft.tla:896-1180; SURVEY §2.3): every
+safety invariant is evaluated two independent ways — pure-Python mirror vs
+vectorized JAX kernel — over (a) reachable states of a small bounded model
+(where the whole suite must hold) and (b) unstructured random states (where
+violations are common, exercising the False paths of both implementations).
+Hand-crafted violating states then pin each invariant's failure mode.
+"""
+
+import jax
+import numpy as np
+import pytest
+
+from raft_tla_tpu.models import oracle as orc
+from raft_tla_tpu.models import smoke
+from raft_tla_tpu.models.dims import CANDIDATE, LEADER, RaftDims
+from raft_tla_tpu.models.invariants import Bounds, constraint_py
+from raft_tla_tpu.models.pystate import PyState, init_state
+from raft_tla_tpu.models.safety import (SAFETY_INVARIANTS,
+                                        SAFETY_INVARIANTS_PY)
+from raft_tla_tpu.models.schema import encode_state, stack_states
+
+DIMS2 = RaftDims(n_servers=2, n_values=1, max_log=3, n_msg_slots=12)
+DIMS3 = RaftDims(n_servers=3, n_values=2, max_log=3, n_msg_slots=12)
+
+
+def _eval_both(states, dims):
+    """Evaluate every safety invariant via mirror and kernel; compare."""
+    batch = stack_states([encode_state(s, dims) for s in states])
+    results = {}
+    for name, build in SAFETY_INVARIANTS.items():
+        kern = jax.jit(jax.vmap(build(dims)))
+        got = np.asarray(kern(batch))
+        want = np.array([SAFETY_INVARIANTS_PY[name](s, dims)
+                         for s in states])
+        mism = np.nonzero(got != want)[0]
+        assert mism.size == 0, (
+            f"{name}: kernel/oracle disagree on {mism.size} states, "
+            f"first at index {mism[0] if mism.size else None}:\n"
+            f"{states[int(mism[0])] if mism.size else None}")
+        results[name] = want
+    return results
+
+
+def test_suite_holds_on_reachable_and_matches_kernel():
+    """On reachable states of a bounded 2-server model the entire suite
+    holds, and mirror == kernel state-for-state."""
+    bounds = Bounds(max_term=2, max_log_len=1, max_msg_count=1)
+    res = orc.bfs([init_state(DIMS2)], DIMS2,
+                  constraint=constraint_py(bounds), check_deadlock=False,
+                  stop_predicate=lambda r: r.distinct_states >= 1200)
+    states = list(res.parent.keys())
+    assert len(states) >= 500
+    results = _eval_both(states, DIMS2)
+    for name, vals in results.items():
+        assert vals.all(), f"{name} violated on a reachable state"
+
+
+def test_kernel_matches_oracle_on_random_states():
+    """Unstructured random states: many violate the suite; both sides must
+    agree exactly (False paths included)."""
+    states = smoke.random_states(DIMS2, 150, seed=7)
+    results = _eval_both(states, DIMS2)
+    # Sanity: the random set actually exercises violations somewhere.
+    assert any((~vals).any() for vals in results.values())
+
+
+def _base(dims, **kw):
+    s = init_state(dims)
+    return s.replace(**kw)
+
+
+def _crafted_violations():
+    """(invariant name, dims, violating state) for every suite member."""
+    d2, d3 = DIMS2, DIMS3
+    out = []
+    # ElectionSafety raft.tla:1124-1129: leader 0 (term 2) lacks an entry
+    # with its own term while server 1 has one.
+    out.append(("ElectionSafety", d2, _base(
+        d2, role=(LEADER, 0), current_term=(2, 2),
+        log=((), ((2, 1),)))))
+    # LogMatching raft.tla:1132-1136: same (index, term), different value.
+    out.append(("LogMatching", d3, _base(
+        d3, log=(((1, 1),), ((1, 2),), ()))))
+    # LeaderVotesQuorum raft.tla:1033-1037: leader without any votes.
+    out.append(("LeaderVotesQuorum", d2, _base(
+        d2, role=(LEADER, 0), current_term=(2, 1))))
+    # CandidateTermNotInLog raft.tla:1041-1047: electable candidate whose
+    # term already appears in a log.
+    out.append(("CandidateTermNotInLog", d2, _base(
+        d2, role=(CANDIDATE, 0), current_term=(2, 2),
+        log=((), ((2, 1),)))))
+    # VotesGrantedInv raft.tla:1145-1153: 0 holds 1's vote at equal term but
+    # misses 1's committed entry.
+    out.append(("VotesGrantedInv", d2, _base(
+        d2, votes_granted=(0b10, 0), log=((), ((1, 1),)),
+        commit_index=(0, 1))))
+    # QuorumLogInv raft.tla:1157-1161 (N=3): 0's committed entry is in no
+    # other log -> a quorum {1, 2} exists with no holder.
+    out.append(("QuorumLogInv", d3, _base(
+        d3, log=(((1, 1),), (), ()), commit_index=(1, 0, 0))))
+    # MoreUpToDateCorrect raft.tla:1167-1172: 0 is more up to date than 1
+    # yet lacks 1's committed entry.
+    out.append(("MoreUpToDateCorrect", d2, _base(
+        d2, log=(((2, 1),), ((1, 1),)), commit_index=(0, 1))))
+    # LeaderCompleteness raft.tla:1176-1180: leader misses a committed entry.
+    out.append(("LeaderCompleteness", d2, _base(
+        d2, role=(LEADER, 0), current_term=(2, 1),
+        log=((), ((1, 1),)), commit_index=(0, 1))))
+    # MessagesInv raft.tla:941-946 via RequestVoteRequestInv :915-920: a
+    # candidate's vote request advertises a wrong lastLogIndex.
+    out.append(("MessagesInv", d2, _base(
+        d2, role=(CANDIDATE, 0), current_term=(2, 1),
+        messages=frozenset({((0, 0, 1, 2, 0, 5), 1)}))))
+    return [x for x in out if x is not None]
+
+
+@pytest.mark.parametrize("name,dims,state",
+                         _crafted_violations(),
+                         ids=[x[0] for x in _crafted_violations()])
+def test_crafted_violation_detected(name, dims, state):
+    py = SAFETY_INVARIANTS_PY[name](state, dims)
+    assert py is False, f"{name} mirror failed to flag the crafted state"
+    kern = SAFETY_INVARIANTS[name](dims)
+    got = bool(kern(encode_state(state, dims)))
+    assert got is False, f"{name} kernel failed to flag the crafted state"
+
+
+def test_registry_resolution(tmp_path):
+    """A cfg naming the full suite resolves through the front-end registry."""
+    from raft_tla_tpu.engine.check import resolve_invariants
+    from raft_tla_tpu.utils.cfg import load_config
+    cfg = tmp_path / "Safety2.cfg"
+    cfg.write_text("""
+CONSTANTS
+    Server = {r1, r2}
+    Value = {v1}
+    MaxTerm = 2
+    MaxLogLen = 1
+    MaxMsgCount = 1
+SPECIFICATION Spec
+INVARIANTS TypeOK MessagesInv LeaderVotesQuorum CandidateTermNotInLog
+           ElectionSafety LogMatching VotesGrantedInv QuorumLogInv
+           MoreUpToDateCorrect LeaderCompleteness
+CONSTRAINT BoundedSpace
+""")
+    setup = load_config(str(cfg))
+    invs = resolve_invariants(setup)
+    assert len(invs) == 10
