@@ -48,6 +48,7 @@ import numpy as np
 
 from ..models.dims import RaftDims
 from ..models.actions import build_expand
+from ..models.invariants import build_inv_id
 from ..models.pystate import PyState
 from ..models.schema import (StateBatch, decode_state, encode_state,
                              flatten_state, state_width, unflatten_state)
@@ -91,36 +92,10 @@ class EngineResult:
         return self.distinct / self.wall_seconds if self.wall_seconds else 0.0
 
 
-class TraceStore:
-    """fp64 -> (parent fp64, action instance id); action -1 marks roots.
-    Python-dict round-1 implementation (native C++ store arrives with M5)."""
-
-    def __init__(self):
-        self._d: Dict[int, Tuple[int, int]] = {}
-        self.roots: Dict[int, PyState] = {}
-
-    def __len__(self):
-        return len(self._d)
-
-    def add_batch(self, fps, parent_fps, actions):
-        d = self._d
-        for f, p, g in zip(fps.tolist(), parent_fps.tolist(),
-                           actions.tolist()):
-            if f not in d:
-                d[f] = (p, g)
-
-    def chain(self, fp: int) -> List[Tuple[int, int]]:
-        """Walk back to a root; returns [(fp, action_into_fp)] root-first."""
-        out = []
-        seen = set()
-        while fp in self._d and fp not in seen:
-            seen.add(fp)
-            p, g = self._d[fp]
-            out.append((fp, g))
-            if g < 0:
-                break
-            fp = p
-        return list(reversed(out))
+# Trace stores (C++-backed with Python fallback) live in engine/trace.py;
+# re-exported here for compatibility.
+from .trace import PyTraceStore as TraceStore  # noqa: E402
+from .trace import make_trace_store  # noqa: E402
 
 
 class BFSEngine:
@@ -160,12 +135,7 @@ class BFSEngine:
             n_new = jnp.sum(new, dtype=_I32)
 
             if inv_fns:
-                def inv_id(st: StateBatch):
-                    out = jnp.int32(-1)
-                    for q in range(len(inv_fns) - 1, -1, -1):
-                        out = jnp.where(inv_fns[q](st), out, jnp.int32(q))
-                    return out
-                inv = jax.vmap(inv_id)(cands)[order]
+                inv = jax.vmap(build_inv_id(inv_fns))(cands)[order]
             else:
                 inv = jnp.full((k,), -1, _I32)
             viol = new & (inv >= 0)
@@ -237,13 +207,16 @@ class BFSEngine:
         self._ingest = jax.jit(ingest, donate_argnums=(2, 4))
         self._fp_rows = jax.jit(fp_rows)
         self._expand1 = jax.jit(expand)
+        self._fp_batch = jax.jit(jax.vmap(fingerprint))
 
     # ------------------------------------------------------------------
     def run(self, init_states: List[PyState]) -> EngineResult:
         dims, cfg = self.dims, self.config
         sw, B, Q = self._sw, self._B, self._Q
         res = EngineResult()
-        trace = TraceStore()
+        # Trace recording off => plain dict store (never written); avoids
+        # triggering the native build for runs that measure raw throughput.
+        trace = make_trace_store() if cfg.record_trace else TraceStore()
         self.trace = trace
 
         qcur = jnp.zeros((Q, sw), _I32)
@@ -346,8 +319,16 @@ class BFSEngine:
     # ------------------------------------------------------------------
     def replay(self, fp: int) -> List[Tuple[int, PyState]]:
         """Counterexample reconstruction: walk the trace back to a root,
-        then re-run the expand kernel forward along the recorded action
-        ids — returns [(action_id, state)] root-first (root action = -1)."""
+        then re-run the expand kernel forward, selecting at each step the
+        candidate whose fingerprint matches the recorded child fingerprint.
+        Returns [(action_id, state)] root-first (root action = -1).
+
+        Matching by fingerprint (not by recorded action id alone) matters:
+        queue rows keep the kernel's message-slot arrangement, while replay
+        re-encodes states canonically (sorted slots, schema.encode_state),
+        so a recorded slot-indexed action (Receive/Duplicate/Drop) may map
+        to a different slot of the canonical parent.  The recorded id is
+        preferred when it still matches, so labels stay stable."""
         chain = self.trace.chain(fp)
         if not chain:
             raise KeyError(f"fingerprint {fp:#x} not in trace")
@@ -356,11 +337,19 @@ class BFSEngine:
             raise KeyError("trace chain does not reach a root")
         state = self.trace.roots[root_fp]
         out = [(-1, state)]
-        for _fp, g in chain[1:]:
+        for child_fp, g_rec in chain[1:]:
             st = encode_state(state, self.dims)
             cands, en, _ovf = self._expand1(st)
-            if not bool(np.asarray(en)[g]):
-                raise RuntimeError(f"replay divergence at action {g}")
+            fph, fpl = self._fp_batch(cands)
+            fps = (np.asarray(fph).astype(np.uint64) << np.uint64(32)) \
+                | np.asarray(fpl).astype(np.uint64)
+            ok = np.asarray(en) & (fps == np.uint64(child_fp))
+            if not ok.any():
+                raise RuntimeError(
+                    f"replay divergence: no enabled candidate matches "
+                    f"fp {child_fp:#018x} (recorded action {g_rec})")
+            g = g_rec if 0 <= g_rec < ok.shape[0] and ok[g_rec] \
+                else int(np.argmax(ok))
             row = jax.tree.map(lambda a: np.asarray(a)[g], cands)
             state = decode_state(StateBatch(*row), self.dims)
             out.append((g, state))

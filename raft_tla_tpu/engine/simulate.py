@@ -31,6 +31,7 @@ import numpy as np
 
 from ..models.actions import build_expand
 from ..models.dims import RaftDims
+from ..models.invariants import build_inv_id
 from ..models.pystate import PyState
 from ..models.schema import (StateBatch, decode_state, encode_state,
                              flatten_state, state_width, unflatten_state)
@@ -65,11 +66,7 @@ class Simulator:
         self._sw = state_width(dims)
         B, G, D = batch, dims.n_instances, depth
 
-        def inv_id(st: StateBatch):
-            out = jnp.int32(-1)
-            for q in range(len(inv_fns) - 1, -1, -1):
-                out = jnp.where(inv_fns[q](st), out, jnp.int32(q))
-            return out
+        inv_id = build_inv_id(inv_fns)
 
         def body(carry, key):
             (rows, roots, tstep, cur_root, abuf, restarts, latch) = carry

@@ -1,0 +1,66 @@
+"""Native (C++) runtime components, bound via ctypes.
+
+The checker's device pipeline is JAX/XLA; the host-side runtime pieces that
+TLC implements natively (trace store; checkpoint IO helpers) are C++ here
+too, built on first use with the ambient ``g++`` into a shared library next
+to the sources.  Everything degrades gracefully: if no compiler is available
+the pure-Python fallbacks in ``engine/trace.py`` are used instead.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Optional
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_SO = os.path.join(_HERE, "libraftnative.so")
+_SRC = [os.path.join(_HERE, "trace_store.cpp")]
+_LOCK = threading.Lock()
+_LIB = None
+_TRIED = False
+
+
+def _build() -> bool:
+    cmd = ["g++", "-O2", "-shared", "-fPIC", "-std=c++17",
+           "-o", _SO] + _SRC
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+        return True
+    except (OSError, subprocess.SubprocessError):
+        return False
+
+
+def load() -> Optional[ctypes.CDLL]:
+    """The shared library, building it if needed; None if unavailable."""
+    global _LIB, _TRIED
+    with _LOCK:
+        if _LIB is not None or _TRIED:
+            return _LIB
+        _TRIED = True
+        newest_src = max(os.path.getmtime(p) for p in _SRC)
+        if not os.path.exists(_SO) or os.path.getmtime(_SO) < newest_src:
+            if not _build():
+                return None
+        try:
+            lib = ctypes.CDLL(_SO)
+        except OSError:
+            return None
+        u64p = ctypes.POINTER(ctypes.c_uint64)
+        i32p = ctypes.POINTER(ctypes.c_int32)
+        lib.ts_create.restype = ctypes.c_void_p
+        lib.ts_create.argtypes = [ctypes.c_uint64]
+        lib.ts_destroy.argtypes = [ctypes.c_void_p]
+        lib.ts_size.restype = ctypes.c_uint64
+        lib.ts_size.argtypes = [ctypes.c_void_p]
+        lib.ts_add_batch.argtypes = [ctypes.c_void_p, u64p, u64p, i32p,
+                                     ctypes.c_uint64]
+        lib.ts_get.restype = ctypes.c_int
+        lib.ts_get.argtypes = [ctypes.c_void_p, ctypes.c_uint64, u64p, i32p]
+        lib.ts_export.restype = ctypes.c_uint64
+        lib.ts_export.argtypes = [ctypes.c_void_p, u64p, u64p, i32p,
+                                  ctypes.c_uint64]
+        _LIB = lib
+        return _LIB

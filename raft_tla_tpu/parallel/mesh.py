@@ -36,9 +36,11 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
 
-from ..engine.bfs import (EngineConfig, EngineResult, TraceStore, Violation)
+from ..engine.bfs import (EngineConfig, EngineResult, TraceStore, Violation,
+                          make_trace_store)
 from ..models.actions import build_expand
 from ..models.dims import RaftDims
+from ..models.invariants import build_inv_id
 from ..models.pystate import PyState
 from ..models.schema import (decode_state, encode_state, flatten_state,
                              state_width, unflatten_state)
@@ -71,7 +73,8 @@ class MeshBFSEngine:
         B, G = cfg.batch, dims.n_instances
         K = B * G
         # Per-chip capacities.
-        QL = max(B, (-(-cfg.queue_capacity // n) // B) * B)
+        per_chip = -(-cfg.queue_capacity // n)
+        QL = max(B, -(-per_chip // B) * B)   # round up to a batch multiple
         CL = -(-cfg.seen_capacity // n)
         self._sw, self._B, self._QL, self._CL = sw, B, QL, CL
 
@@ -113,12 +116,7 @@ class MeshBFSEngine:
             n_new = jnp.sum(new, dtype=_I32)      # local share of global new
 
             if inv_fns:
-                def inv_id(st):
-                    out = jnp.int32(-1)
-                    for q in range(len(inv_fns) - 1, -1, -1):
-                        out = jnp.where(inv_fns[q](st), out, jnp.int32(q))
-                    return out
-                inv = jax.vmap(inv_id)(cands)
+                inv = jax.vmap(build_inv_id(inv_fns))(cands)
             else:
                 inv = jnp.full((k,), -1, _I32)
             viol = new & (inv >= 0)
@@ -219,13 +217,14 @@ class MeshBFSEngine:
 
         self._fp_rows = jax.jit(fp_rows)
         self._expand1 = jax.jit(expand)
+        self._fp_batch = jax.jit(jax.vmap(fingerprint))
 
     # ------------------------------------------------------------------
     def run(self, init_states: List[PyState]) -> EngineResult:
         dims, cfg = self.dims, self.config
         n, sw, B, QL, CL = self.n_dev, self._sw, self._B, self._QL, self._CL
         res = EngineResult()
-        trace = TraceStore()
+        trace = make_trace_store() if cfg.record_trace else TraceStore()
         self.trace = trace
 
         qcur = jnp.zeros((n, QL, sw), _I32)

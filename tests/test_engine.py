@@ -85,6 +85,29 @@ def test_violation_found_at_min_depth_and_replays():
         assert s_next[1] in orc.successor_set(s_prev[1], DIMS)
 
 
+def test_replay_from_real_init_through_message_actions():
+    """Regression: replay must survive message-slot reordering.  Queue rows
+    keep the kernel's slot arrangement while replay re-encodes canonically
+    (sorted slots), so a deep trace from the true Init that passes through
+    multiple in-flight messages used to diverge on slot-indexed actions;
+    replay now matches children by fingerprint (engine/bfs.py replay)."""
+    dims = RaftDims(n_servers=2, n_values=1, max_log=2, n_msg_slots=8)
+    bounds = Bounds(max_term=2, max_log_len=1, max_msg_count=1)
+    eng = BFSEngine(dims, invariants={
+        "NoLeader": lambda st: jnp.all(st.role != LEADER)},
+        constraint=build_constraint(dims, bounds),
+        config=small_config(batch=128))
+    res = eng.run([init_state(dims)])
+    assert res.stop_reason == "violation"
+    steps = eng.replay(res.violation.fingerprint)
+    # The minimal election needs both RequestVote sends in flight at once,
+    # so the trace necessarily crosses multi-message states.
+    assert len(steps) >= 5
+    assert steps[-1][1] == res.violation.state
+    for (s_prev, s_next) in zip(steps, steps[1:]):
+        assert s_next[1] in orc.successor_set(s_prev[1], dims)
+
+
 def test_multiple_init_states(engine_cls=BFSEngine):
     """Several roots (the smoke-mode shape): counts still match."""
     dims = DIMS

@@ -1,0 +1,65 @@
+"""Tests for the C++ trace store (native/trace_store.cpp via ctypes) and
+its drop-in equivalence with the Python fallback."""
+
+import numpy as np
+import pytest
+
+from raft_tla_tpu import native
+from raft_tla_tpu.engine.trace import (NativeTraceStore, PyTraceStore,
+                                       make_trace_store)
+
+
+def _fill(store, n=5000, seed=3):
+    rng = np.random.default_rng(seed)
+    fps = rng.integers(1, 1 << 63, n, dtype=np.uint64)
+    parents = rng.integers(1, 1 << 63, n, dtype=np.uint64)
+    actions = rng.integers(0, 99, n, dtype=np.int32)
+    store.add_batch(fps, parents, actions)
+    return fps, parents, actions
+
+
+def test_native_lib_builds():
+    assert native.load() is not None, "g++ build of trace_store.cpp failed"
+
+
+def test_native_matches_python_store():
+    lib = native.load()
+    assert lib is not None
+    ns, ps = NativeTraceStore(lib, 1024), PyTraceStore()
+    fps, parents, actions = _fill(ns)
+    _fill(ps)
+    # Duplicate batch: first insert must win in both.
+    ns.add_batch(fps, parents[::-1].copy(), actions[::-1].copy())
+    ps.add_batch(fps, parents[::-1].copy(), actions[::-1].copy())
+    assert len(ns) == len(ps)
+    rng = np.random.default_rng(9)
+    for fp in rng.choice(fps, 200, replace=False):
+        assert ns.get(int(fp)) == ps.get(int(fp))
+    assert ns.get(12345) is None and ps.get(12345) is None
+
+
+def test_native_growth_and_export():
+    lib = native.load()
+    assert lib is not None
+    ns = NativeTraceStore(lib, 1024)       # forces several grows
+    fps, parents, actions = _fill(ns, n=50000, seed=11)
+    uniq = len(np.unique(fps))
+    assert len(ns) == uniq
+    efps, eparents, eactions = ns.export()
+    assert len(efps) == uniq
+    # Export round-trips through a fresh store.
+    ns2 = NativeTraceStore(lib, 16)
+    ns2.add_batch(efps, eparents, eactions)
+    for fp in fps[:100]:
+        assert ns2.get(int(fp)) == ns.get(int(fp))
+
+
+def test_chain_walkback():
+    store = make_trace_store()
+    # Root 100 (action -1), chain 100 -> 200 -> 300.
+    store.add_batch(np.array([100, 200, 300], np.uint64),
+                    np.array([0, 100, 200], np.uint64),
+                    np.array([-1, 5, 7], np.int32))
+    assert store.chain(300) == [(100, -1), (200, 5), (300, 7)]
+    assert store.chain(100) == [(100, -1)]
+    assert store.chain(999) == []
