@@ -47,6 +47,16 @@ def main(argv=None):
     c.add_argument("--max-seconds", type=float, default=None)
     c.add_argument("--no-trace", action="store_true",
                    help="disable counterexample trace recording")
+    c.add_argument("--checkpoint-dir", default=None,
+                   help="write level-boundary snapshots here (TLC states/)")
+    c.add_argument("--checkpoint-every", type=int, default=1,
+                   help="snapshot every k BFS levels")
+    c.add_argument("--checkpoint-interval", type=float, default=60.0,
+                   help="min seconds between snapshots (snapshot cost is "
+                        "O(seen states); 0 = every eligible level)")
+    c.add_argument("--resume", default=None,
+                   help="checkpoint .npz to resume from, or 'auto' for the "
+                        "latest one in --checkpoint-dir")
 
     s = sub.add_parser("simulate", help="random-trace simulation")
     common(s)
@@ -75,9 +85,27 @@ def main(argv=None):
             batch=args.batch, queue_capacity=args.queue_capacity,
             seen_capacity=args.seen_capacity,
             max_diameter=args.max_diameter, max_seconds=args.max_seconds,
-            record_trace=not args.no_trace)
+            record_trace=not args.no_trace,
+            checkpoint_dir=args.checkpoint_dir,
+            checkpoint_every=args.checkpoint_every,
+            checkpoint_interval_seconds=args.checkpoint_interval)
         engine = make_engine(setup, cfgobj)
-        res = engine.run(initial_states(setup, seed=args.seed))
+        resume = None
+        if args.resume:
+            if args.resume == "auto":
+                if not args.checkpoint_dir:
+                    p.error("--resume auto requires --checkpoint-dir")
+                from .engine import checkpoint as ckpt_mod
+                resume = ckpt_mod.latest(args.checkpoint_dir)
+                if resume is None:
+                    p.error("--resume auto: no checkpoint found in "
+                            f"{args.checkpoint_dir!r}")
+                print(f"resuming from {resume}")
+            else:
+                resume = args.resume
+        res = engine.run(
+            initial_states(setup, seed=args.seed) if resume is None else None,
+            resume=resume)
         print(format_result(res))
         if res.violation is not None:
             if args.no_trace:

@@ -67,6 +67,12 @@ class EngineConfig:
     record_trace: bool = True
     max_seconds: Optional[float] = None   # StopAfter duration budget
     max_diameter: Optional[int] = None    # StopAfter diameter budget
+    checkpoint_dir: Optional[str] = None  # R8: level-boundary snapshots
+    checkpoint_every: int = 1             # snapshot every k levels...
+    checkpoint_interval_seconds: float = 0.0  # ...but at most this often.
+    # Snapshot cost is O(seen states), so a per-level cadence is quadratic
+    # over a long run; big runs should set a TLC-style time cadence (TLC
+    # defaults to ~30 min between states/ checkpoints) and the CLI does.
 
 
 @dataclasses.dataclass
@@ -210,9 +216,23 @@ class BFSEngine:
         self._fp_batch = jax.jit(jax.vmap(fingerprint))
 
     # ------------------------------------------------------------------
-    def run(self, init_states: List[PyState]) -> EngineResult:
+    def run(self, init_states: Optional[List[PyState]] = None,
+            resume=None) -> EngineResult:
+        """Run to exhaustion (or budget/violation).  Pass either
+        ``init_states`` for a fresh run or ``resume`` (a
+        ``checkpoint.Checkpoint`` or a path to one) to continue an
+        interrupted run from its last level-boundary snapshot."""
+        from . import checkpoint as ckpt_mod
         dims, cfg = self.dims, self.config
         sw, B, Q = self._sw, self._B, self._Q
+        if resume is not None:
+            if isinstance(resume, str):
+                resume = ckpt_mod.load(resume)
+            if resume.dims != dims:
+                raise ValueError(
+                    f"checkpoint dims {resume.dims} != engine dims {dims}")
+        elif init_states is None:
+            raise ValueError("need init_states or resume")
         res = EngineResult()
         # Trace recording off => plain dict store (never written); avoids
         # triggering the native build for runs that measure raw throughput.
@@ -236,40 +256,98 @@ class BFSEngine:
         qnext, next_count, seen = out[0], out[1], out[2]
         t0 = time.time()
 
-        # Ingest initial states in B-sized chunks; register trace roots.
-        rows_np = np.stack([
-            flatten_state(encode_state(s, dims), dims) for s in init_states])
-        if cfg.record_trace:
-            rhi, rlo = (np.asarray(x) for x in
-                        self._fp_rows(jnp.asarray(rows_np)))
-            for idx, s in enumerate(init_states):
-                fp = (int(rhi[idx]) << 32) | int(rlo[idx])
-                trace.roots.setdefault(fp, s)
-        for base in range(0, len(rows_np), B):
-            chunk = rows_np[base:base + B]
-            pad = np.zeros((B - len(chunk), sw), np.int32)
-            valid = np.arange(B) < len(chunk)
-            qnext, next_count, seen, n_new, tr, vinfo = self._ingest(
-                jnp.asarray(np.concatenate([chunk, pad])),
-                jnp.asarray(valid), qnext, next_count, seen)
-            res.distinct += int(n_new)
-            self._record(trace, tr, int(n_new))
-            if int(next_count) > Q:
-                raise RuntimeError("queue capacity exceeded by initial states")
-            if int(seen.size) > cfg.seen_capacity:
-                raise RuntimeError("seen-set capacity exceeded")
-            if self._check_violation(res, vinfo):
-                break
+        if resume is not None:
+            # Restore the level-boundary image: sentinel-pad the saved
+            # (sorted) FPSet keys back to capacity, reload the frontier,
+            # counters, and trace records/roots.
+            n_keys = resume.seen_hi.shape[0]
+            if n_keys > cfg.seen_capacity:
+                raise RuntimeError(
+                    f"checkpoint has {n_keys} seen keys > seen_capacity "
+                    f"{cfg.seen_capacity}")
+            pad_n = cfg.seen_capacity - n_keys
+            seen = fpset.FPSet(
+                hi=jnp.concatenate([
+                    jnp.asarray(resume.seen_hi),
+                    jnp.full((pad_n,), fpset.SENTINEL, jnp.uint32)]),
+                lo=jnp.concatenate([
+                    jnp.asarray(resume.seen_lo),
+                    jnp.full((pad_n,), fpset.SENTINEL, jnp.uint32)]),
+                size=jnp.int32(n_keys))
+            fr = np.ascontiguousarray(resume.frontier, np.int32)
+            if len(fr) > Q:
+                raise RuntimeError(
+                    f"checkpoint frontier {len(fr)} > queue capacity {Q}")
+            qcur = jnp.zeros((Q, sw), _I32).at[:len(fr)].set(jnp.asarray(fr))
+            cur_count = len(fr)
+            res.distinct = resume.distinct
+            res.generated = resume.generated
+            res.diameter = resume.diameter
+            res.levels = list(resume.levels)
+            # Duration (TLCGet("duration")-style) accumulates across
+            # restarts: back-date t0 so wall_seconds, states/sec, and the
+            # max_seconds budget all measure total checking time.
+            t0 -= resume.wall_seconds
+            if cfg.record_trace:
+                if resume.distinct > 0 and resume.trace_fps.size == 0:
+                    raise ValueError(
+                        "checkpoint was written with trace recording "
+                        "disabled; counterexample replay could never reach "
+                        "a root — resume with record_trace=False "
+                        "(--no-trace) or restart from scratch")
+                trace.add_batch(resume.trace_fps, resume.trace_parents,
+                                resume.trace_actions)
+                trace.roots.update(resume.roots)
+        else:
+            # Ingest initial states in B-sized chunks; register trace roots.
+            rows_np = np.stack([
+                flatten_state(encode_state(s, dims), dims)
+                for s in init_states])
+            if cfg.record_trace:
+                rhi, rlo = (np.asarray(x) for x in
+                            self._fp_rows(jnp.asarray(rows_np)))
+                for idx, s in enumerate(init_states):
+                    fp = (int(rhi[idx]) << 32) | int(rlo[idx])
+                    trace.roots.setdefault(fp, s)
+            for base in range(0, len(rows_np), B):
+                chunk = rows_np[base:base + B]
+                pad = np.zeros((B - len(chunk), sw), np.int32)
+                valid = np.arange(B) < len(chunk)
+                qnext, next_count, seen, n_new, tr, vinfo = self._ingest(
+                    jnp.asarray(np.concatenate([chunk, pad])),
+                    jnp.asarray(valid), qnext, next_count, seen)
+                res.distinct += int(n_new)
+                self._record(trace, tr, int(n_new))
+                if int(next_count) > Q:
+                    raise RuntimeError(
+                        "queue capacity exceeded by initial states")
+                if int(seen.size) > cfg.seen_capacity:
+                    raise RuntimeError("seen-set capacity exceeded")
+                if self._check_violation(res, vinfo):
+                    break
 
-        # levels[] counts enqueued (constraint-passing) states per level,
-        # mirroring the oracle's frontier sizes.
-        res.levels.append(int(next_count))
-        qcur, qnext = qnext, qcur
-        cur_count = int(next_count)
-        next_count = jnp.int32(0)
+            # levels[] counts enqueued (constraint-passing) states per
+            # level, mirroring the oracle's frontier sizes.
+            res.levels.append(int(next_count))
+            qcur, qnext = qnext, qcur
+            cur_count = int(next_count)
+            next_count = jnp.int32(0)
 
+        # A resumed run must not rewrite the snapshot it just loaded (a
+        # trace-off resume would overwrite a trace-carrying file with an
+        # empty trace), and its interval clock starts at the restart.
+        skip_ckpt_level = resume.diameter if resume is not None else -1
+        last_ckpt = time.time() if resume is not None else float("-inf")
         while cur_count > 0 and res.violation is None \
                 and res.stop_reason == "exhausted":
+            if cfg.checkpoint_dir is not None \
+                    and res.diameter % max(1, cfg.checkpoint_every) == 0 \
+                    and res.diameter != skip_ckpt_level \
+                    and (time.time() - last_ckpt
+                         >= cfg.checkpoint_interval_seconds):
+                self._write_checkpoint(qcur, cur_count, seen, res, trace,
+                                       wall=time.time() - t0)
+                last_ckpt = time.time()
             if cfg.max_diameter is not None \
                     and res.diameter >= cfg.max_diameter:
                 res.stop_reason = "diameter_budget"
@@ -356,6 +434,29 @@ class BFSEngine:
         return out
 
     # ------------------------------------------------------------------
+    def _write_checkpoint(self, qcur, cur_count, seen, res, trace, wall):
+        from . import checkpoint as ckpt_mod
+        import os
+        if self.config.record_trace:
+            tf, tp, ta = trace.export()
+            roots = dict(trace.roots)
+        else:
+            tf = np.empty(0, np.uint64)
+            tp = np.empty(0, np.uint64)
+            ta = np.empty(0, np.int32)
+            roots = {}
+        seen_hi, seen_lo = fpset.to_host_keys(seen)
+        ck = ckpt_mod.Checkpoint(
+            dims=self.dims,
+            frontier=np.asarray(qcur[:cur_count]),
+            seen_hi=seen_hi, seen_lo=seen_lo,
+            distinct=res.distinct, generated=res.generated,
+            diameter=res.diameter, levels=tuple(res.levels),
+            wall_seconds=wall,
+            trace_fps=tf, trace_parents=tp, trace_actions=ta, roots=roots)
+        ckpt_mod.save(os.path.join(self.config.checkpoint_dir,
+                                   f"level_{res.diameter:05d}.npz"), ck)
+
     def _record(self, trace, tr, n_new):
         if n_new == 0 or not self.config.record_trace:
             return

@@ -1,0 +1,134 @@
+"""Checkpoint/resume — TLC's ``states/`` snapshot dir rebuilt (SURVEY §2.4 R8).
+
+TLC periodically writes its FPSet + unexplored-queue to the ``states/``
+directory so an interrupted run can resume (acknowledged by the reference's
+``.gitignore:1``).  The TPU engine's equivalent is a *level-boundary*
+snapshot: because the BFS is level-synchronous, the complete engine state
+between levels is exactly
+
+    (frontier rows, FPSet keys, counters, trace records, trace roots)
+
+and all of it is host-materializable as flat numpy arrays.  One compressed
+``.npz`` per snapshot, written atomically (tmp + rename) so a crash during
+write never corrupts the latest good checkpoint.
+
+Resume restores the FPSet by sentinel-padding the saved (already lex-sorted)
+key arrays back to capacity — no re-hashing, no re-exploration: the run
+continues from the exact level it stopped at, and counterexample replay
+still reaches roots discovered before the interruption.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import pickle
+from typing import Dict, Optional, Tuple
+
+import numpy as np
+
+from ..models.dims import RaftDims
+from ..models.pystate import PyState
+
+FORMAT_VERSION = 1
+
+
+@dataclasses.dataclass
+class Checkpoint:
+    """Host-side image of a BFS engine paused at a level boundary."""
+
+    dims: RaftDims
+    frontier: np.ndarray           # [cur_count, state_width] int32
+    seen_hi: np.ndarray            # [size] uint32, lex-sorted with seen_lo
+    seen_lo: np.ndarray            # [size] uint32
+    distinct: int
+    generated: int
+    diameter: int
+    levels: Tuple[int, ...]
+    wall_seconds: float          # cumulative checking time before the snapshot
+    trace_fps: np.ndarray          # [T] uint64
+    trace_parents: np.ndarray      # [T] uint64
+    trace_actions: np.ndarray      # [T] int32
+    roots: Dict[int, PyState]
+
+
+def save(path: str, ckpt: Checkpoint) -> None:
+    """Atomically write ``ckpt`` to ``path`` (a ``.npz`` file)."""
+    meta = {
+        "version": FORMAT_VERSION,
+        "dims": dataclasses.asdict(ckpt.dims),
+        "distinct": ckpt.distinct,
+        "generated": ckpt.generated,
+        "diameter": ckpt.diameter,
+        "levels": list(ckpt.levels),
+        "wall_seconds": ckpt.wall_seconds,
+    }
+    os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:
+        np.savez_compressed(
+            f,
+            meta=np.frombuffer(json.dumps(meta).encode(), np.uint8),
+            frontier=np.ascontiguousarray(ckpt.frontier, np.int32),
+            seen_hi=np.ascontiguousarray(ckpt.seen_hi, np.uint32),
+            seen_lo=np.ascontiguousarray(ckpt.seen_lo, np.uint32),
+            trace_fps=np.ascontiguousarray(ckpt.trace_fps, np.uint64),
+            trace_parents=np.ascontiguousarray(ckpt.trace_parents, np.uint64),
+            trace_actions=np.ascontiguousarray(ckpt.trace_actions, np.int32),
+            roots=np.frombuffer(pickle.dumps(ckpt.roots), np.uint8))
+        f.flush()
+        os.fsync(f.fileno())     # the rename must never land a torn file
+    os.replace(tmp, path)
+    dfd = os.open(os.path.dirname(os.path.abspath(path)), os.O_RDONLY)
+    try:
+        os.fsync(dfd)
+    finally:
+        os.close(dfd)
+
+
+def load(path: str) -> Checkpoint:
+    with np.load(path) as z:
+        meta = json.loads(bytes(z["meta"]).decode())
+        if meta["version"] != FORMAT_VERSION:
+            raise ValueError(
+                f"checkpoint format v{meta['version']} != v{FORMAT_VERSION}")
+        return Checkpoint(
+            dims=RaftDims(**meta["dims"]),
+            frontier=z["frontier"],
+            seen_hi=z["seen_hi"],
+            seen_lo=z["seen_lo"],
+            distinct=meta["distinct"],
+            generated=meta["generated"],
+            diameter=meta["diameter"],
+            levels=tuple(meta["levels"]),
+            wall_seconds=float(meta.get("wall_seconds", 0.0)),
+            trace_fps=z["trace_fps"],
+            trace_parents=z["trace_parents"],
+            trace_actions=z["trace_actions"],
+            roots=pickle.loads(bytes(z["roots"])))
+
+
+def latest(checkpoint_dir: str) -> Optional[str]:
+    """Path of the newest *readable* checkpoint in ``checkpoint_dir``.
+    Unreadable/truncated files (e.g. from a crash mid-write on a filesystem
+    that reordered the rename) are skipped, falling back to the next-newest
+    intact snapshot."""
+    if not os.path.isdir(checkpoint_dir):
+        return None
+    levels = []
+    for name in os.listdir(checkpoint_dir):
+        if name.startswith("level_") and name.endswith(".npz"):
+            try:
+                levels.append((int(name[len("level_"):-len(".npz")]), name))
+            except ValueError:
+                continue
+    for _lvl, name in sorted(levels, reverse=True):
+        path = os.path.join(checkpoint_dir, name)
+        try:
+            with np.load(path) as z:
+                json.loads(bytes(z["meta"]).decode())
+            return path
+        except Exception:
+            continue
+    return None
