@@ -30,30 +30,33 @@ def main(argv=None):
     p = argparse.ArgumentParser(prog="raft_tla_tpu")
     sub = p.add_subparsers(dest="cmd", required=True)
 
+    # Flags default to None so the resolution chain is visible at the use
+    # sites: CLI flag > cfg "\* TPU:" backend directive > built-in default.
     def common(sp):
         sp.add_argument("cfg", help="TLC .cfg file (e.g. MCraft.cfg)")
         sp.add_argument("--platform", default=None,
                         help="jax platform override (e.g. cpu)")
-        sp.add_argument("--batch", type=int, default=1024)
-        sp.add_argument("--n-msg-slots", type=int, default=32)
+        sp.add_argument("--batch", type=int, default=None)
+        sp.add_argument("--n-msg-slots", type=int, default=None)
         sp.add_argument("--max-log", type=int, default=None)
         sp.add_argument("--seed", type=int, default=0)
 
     c = sub.add_parser("check", help="exhaustive BFS check")
     common(c)
-    c.add_argument("--queue-capacity", type=int, default=1 << 20)
-    c.add_argument("--seen-capacity", type=int, default=1 << 22)
+    c.add_argument("--queue-capacity", type=int, default=None)
+    c.add_argument("--seen-capacity", type=int, default=None)
     c.add_argument("--max-diameter", type=int, default=None)
     c.add_argument("--max-seconds", type=float, default=None)
     c.add_argument("--no-trace", action="store_true",
                    help="disable counterexample trace recording")
     c.add_argument("--checkpoint-dir", default=None,
                    help="write level-boundary snapshots here (TLC states/)")
-    c.add_argument("--checkpoint-every", type=int, default=1,
-                   help="snapshot every k BFS levels")
-    c.add_argument("--checkpoint-interval", type=float, default=60.0,
+    c.add_argument("--checkpoint-every", type=int, default=None,
+                   help="snapshot every k BFS levels (default 1)")
+    c.add_argument("--checkpoint-interval", type=float, default=None,
                    help="min seconds between snapshots (snapshot cost is "
-                        "O(seen states); 0 = every eligible level)")
+                        "O(seen states); 0 = every eligible level; "
+                        "default 60)")
     c.add_argument("--resume", default=None,
                    help="checkpoint .npz to resume from, or 'auto' for the "
                         "latest one in --checkpoint-dir")
@@ -65,8 +68,21 @@ def main(argv=None):
     s.add_argument("--max-seconds", type=float, default=None)
 
     args = p.parse_args(argv)
-    if args.platform:
-        _force_platform(args.platform)
+    platform = args.platform
+    if platform is None:
+        # The PLATFORM backend directive must act BEFORE jax initializes,
+        # i.e. before the cfg loader (which imports the kernels) runs — so
+        # read just that one directive with a self-contained regex.
+        import re
+        try:
+            with open(args.cfg) as f:
+                m = re.search(r"^\s*\\\*\s*TPU:\s*PLATFORM\s*=\s*(\S+)",
+                              f.read(), flags=re.M | re.I)
+            platform = m.group(1) if m else None
+        except OSError:
+            platform = None
+    if platform:
+        _force_platform(platform)
 
     from .engine.bfs import EngineConfig
     from .engine.check import (format_result, initial_states, make_engine)
@@ -78,28 +94,44 @@ def main(argv=None):
     print(f"model: {setup.dims.n_servers} servers "
           f"{tuple(setup.server_names)}, {setup.dims.n_values} values; "
           f"smoke={setup.smoke} invariants={setup.invariants} "
-          f"bounds={setup.bounds}")
+          f"bounds={setup.bounds}"
+          + (f" backend={setup.backend}" if setup.backend else ""))
+
+    def resolve(flag, key, default):
+        if flag is not None:
+            return flag
+        return setup.backend.get(key, default)
+
+    batch = resolve(args.batch, "BATCH", 1024)
 
     if args.cmd == "check":
         cfgobj = EngineConfig(
-            batch=args.batch, queue_capacity=args.queue_capacity,
-            seen_capacity=args.seen_capacity,
+            batch=batch,
+            queue_capacity=resolve(args.queue_capacity,
+                                   "QUEUE_CAPACITY", 1 << 20),
+            seen_capacity=resolve(args.seen_capacity,
+                                  "SEEN_CAPACITY", 1 << 22),
             max_diameter=args.max_diameter, max_seconds=args.max_seconds,
             record_trace=not args.no_trace,
-            checkpoint_dir=args.checkpoint_dir,
-            checkpoint_every=args.checkpoint_every,
-            checkpoint_interval_seconds=args.checkpoint_interval)
+            checkpoint_dir=resolve(args.checkpoint_dir,
+                                   "CHECKPOINT_DIR", None),
+            checkpoint_every=resolve(args.checkpoint_every,
+                                     "CHECKPOINT_EVERY", 1),
+            checkpoint_interval_seconds=float(
+                resolve(args.checkpoint_interval,
+                        "CHECKPOINT_INTERVAL", 60.0)))
         engine = make_engine(setup, cfgobj)
         resume = None
         if args.resume:
             if args.resume == "auto":
-                if not args.checkpoint_dir:
-                    p.error("--resume auto requires --checkpoint-dir")
+                if not cfgobj.checkpoint_dir:
+                    p.error("--resume auto requires --checkpoint-dir "
+                            "(or a CHECKPOINT_DIR backend directive)")
                 from .engine import checkpoint as ckpt_mod
-                resume = ckpt_mod.latest(args.checkpoint_dir)
+                resume = ckpt_mod.latest(cfgobj.checkpoint_dir)
                 if resume is None:
                     p.error("--resume auto: no checkpoint found in "
-                            f"{args.checkpoint_dir!r}")
+                            f"{cfgobj.checkpoint_dir!r}")
                 print(f"resuming from {resume}")
             else:
                 resume = args.resume
@@ -130,7 +162,7 @@ def main(argv=None):
     from .engine.simulate import Simulator
     sim = Simulator(setup.dims, invariants=resolve_invariants(setup),
                     constraint=resolve_constraint(setup),
-                    batch=args.batch, depth=args.depth)
+                    batch=batch, depth=args.depth)
     max_seconds = (args.max_seconds if args.max_seconds is not None
                    else setup.max_seconds)   # StopAfter duration budget
     res = sim.run(initial_states(setup, seed=args.seed),

@@ -298,6 +298,13 @@ class BFSEngine:
                 trace.add_batch(resume.trace_fps, resume.trace_parents,
                                 resume.trace_actions)
                 trace.roots.update(resume.roots)
+            elif resume.trace_fps.size > 0 and cfg.checkpoint_dir is not None:
+                raise ValueError(
+                    "resuming a trace-carrying checkpoint with trace "
+                    "recording disabled would write trace-less snapshots "
+                    "into the same directory, shadowing the intact ones "
+                    "for any later trace-on resume; use a different "
+                    "checkpoint_dir or keep tracing enabled")
         else:
             # Ingest initial states in B-sized chunks; register trace roots.
             rows_np = np.stack([

@@ -57,10 +57,28 @@ def resolve_constraint(setup: CheckSetup) -> Optional[Callable]:
     return constraint
 
 
+def engine_config_from_backend(setup: CheckSetup) -> EngineConfig:
+    """EngineConfig seeded from the cfg's ``\\* TPU:`` backend directives
+    (utils/cfg.py).  Used whenever the caller does not supply an explicit
+    EngineConfig, so the precedence chain (caller > cfg directive >
+    built-in default) holds for the API entry points, not just the CLI."""
+    be = setup.backend
+    return EngineConfig(
+        batch=be.get("BATCH", EngineConfig.batch),
+        queue_capacity=be.get("QUEUE_CAPACITY", EngineConfig.queue_capacity),
+        seen_capacity=be.get("SEEN_CAPACITY", EngineConfig.seen_capacity),
+        checkpoint_dir=be.get("CHECKPOINT_DIR"),
+        checkpoint_every=be.get("CHECKPOINT_EVERY",
+                                EngineConfig.checkpoint_every),
+        checkpoint_interval_seconds=float(
+            be.get("CHECKPOINT_INTERVAL",
+                   EngineConfig.checkpoint_interval_seconds)))
+
+
 def make_engine(setup: CheckSetup,
                 engine_config: Optional[EngineConfig] = None) -> BFSEngine:
     import dataclasses as _dc
-    base = engine_config or EngineConfig()
+    base = engine_config or engine_config_from_backend(setup)
     cfg = _dc.replace(          # never mutate the caller's config
         base,
         check_deadlock=setup.check_deadlock,
@@ -81,7 +99,7 @@ def initial_states(setup: CheckSetup, seed: int = 0) -> List[PyState]:
 
 def run_check(cfg_path: str, engine_config: Optional[EngineConfig] = None,
               seed: int = 0, max_log: Optional[int] = None,
-              n_msg_slots: int = 32) -> EngineResult:
+              n_msg_slots: Optional[int] = None) -> EngineResult:
     """One-call path: parse cfg, build engine, run.  The reference configs
     (/root/reference/MCraft.cfg, Smokeraft.cfg) run unmodified."""
     setup = load_config(cfg_path, max_log=max_log, n_msg_slots=n_msg_slots)

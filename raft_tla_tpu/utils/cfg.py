@@ -22,6 +22,15 @@ harnesses actually use:
 Bounded exhaustive configs (the BASELINE.json runs) use ordinary cfg constants
 ``MaxTerm/MaxLogLen/MaxMsgCount`` consumed by the built-in ``BoundedSpace``
 constraint — standard TLC practice, no grammar extension required.
+
+**TPU backend keys** (the ``TPUraft.cfg`` mechanism from the BASELINE.json
+north star): engine parameters ride in the cfg as ``\\* TPU: KEY = VALUE``
+comment directives, e.g. ``\\* TPU: BATCH = 8192``.  Because they are TLC
+comments, a backend-annotated cfg still parses and runs under stock TLC
+unchanged — the cfg stays the single source of truth for both engines.
+Recognized keys: BATCH, QUEUE_CAPACITY, SEEN_CAPACITY, N_MSG_SLOTS,
+MAX_LOG, PLATFORM, CHECKPOINT_DIR, CHECKPOINT_EVERY, CHECKPOINT_INTERVAL.
+Precedence everywhere: CLI flag > cfg backend key > built-in default.
 """
 
 from __future__ import annotations
@@ -53,6 +62,7 @@ class ParsedCfg:
     action_constraints: List[str] = dataclasses.field(default_factory=list)
     properties: List[str] = dataclasses.field(default_factory=list)
     check_deadlock: bool = True        # TLC default
+    backend: Dict[str, object] = dataclasses.field(default_factory=dict)
 
 
 def _tokenize(text: str) -> List[str]:
@@ -62,9 +72,36 @@ def _tokenize(text: str) -> List[str]:
     return re.findall(r"<-|=|\{|\}|,|[^\s{},=]+", text)
 
 
+_BACKEND_KEYS = {
+    "BATCH", "QUEUE_CAPACITY", "SEEN_CAPACITY", "N_MSG_SLOTS", "MAX_LOG",
+    "PLATFORM", "CHECKPOINT_DIR", "CHECKPOINT_EVERY", "CHECKPOINT_INTERVAL",
+}
+
+
+def parse_backend_directives(text: str) -> Dict[str, object]:
+    """``\\* TPU: KEY = VALUE`` comment directives (see module docstring)."""
+    out: Dict[str, object] = {}
+    for m in re.finditer(r"^\s*\\\*\s*TPU:\s*(\w+)\s*=\s*(\S+)",
+                         text, flags=re.M | re.I):
+        key, raw = m.group(1).upper(), m.group(2)
+        if key not in _BACKEND_KEYS:
+            raise ValueError(f"unknown TPU backend key {key!r}; "
+                             f"recognized: {sorted(_BACKEND_KEYS)}")
+        if re.fullmatch(r"-?\d+", raw):
+            out[key] = int(raw)
+        elif re.fullmatch(r"-?\d+\.\d*", raw):
+            out[key] = float(raw)
+        elif raw in ("TRUE", "FALSE"):
+            out[key] = raw == "TRUE"
+        else:
+            out[key] = raw
+    return out
+
+
 def parse_cfg(text: str) -> ParsedCfg:
     toks = _tokenize(text)
     cfg = ParsedCfg()
+    cfg.backend = parse_backend_directives(text)
     i, n = 0, len(toks)
 
     def parse_value(j: int) -> Tuple[object, int]:
@@ -180,13 +217,20 @@ class CheckSetup:
     server_names: Tuple[str, ...] = ()
     value_names: Tuple[str, ...] = ()
     cfg: Optional[ParsedCfg] = None
+    backend: Dict[str, object] = dataclasses.field(default_factory=dict)
 
 
 def load_config(cfg_path: str, max_log: Optional[int] = None,
-                n_msg_slots: int = 32) -> CheckSetup:
-    """Parse cfg + companion module, intern model values, derive dims."""
+                n_msg_slots: Optional[int] = None) -> CheckSetup:
+    """Parse cfg + companion module, intern model values, derive dims.
+    ``max_log``/``n_msg_slots`` arguments (CLI flags) override the cfg's
+    ``\\* TPU:`` backend directives, which override built-in defaults."""
     with open(cfg_path) as f:
         cfg = parse_cfg(f.read())
+    if max_log is None:
+        max_log = cfg.backend.get("MAX_LOG")
+    if n_msg_slots is None:
+        n_msg_slots = cfg.backend.get("N_MSG_SLOTS", 32)
     moddefs: Dict[str, object] = {}
     stop_dur = stop_dia = None
     # Scan the companion module and its EXTENDS chain (Smokeraft EXTENDS
@@ -268,4 +312,5 @@ def load_config(cfg_path: str, max_log: Optional[int] = None,
         check_deadlock=cfg.check_deadlock,
         smoke=smoke, smoke_k=smoke_k,
         max_seconds=max_seconds, max_diameter=max_diameter,
-        server_names=servers, value_names=values, cfg=cfg)
+        server_names=servers, value_names=values, cfg=cfg,
+        backend=dict(cfg.backend))

@@ -65,3 +65,43 @@ def test_unknown_constant_raises(tmp_path):
     cfgf.write_text("CONSTANT Value = {v1}\nSPECIFICATION Spec\n")
     with pytest.raises(ValueError, match="Server"):
         load_config(str(cfgf))
+
+
+def test_parse_tpu_backend_directives():
+    """"\\* TPU:" comment directives select the engine backend while the
+    file stays a valid stock-TLC cfg (BASELINE.json north star)."""
+    s = load_config(os.path.join(REPO, "configs/TPUraft.cfg"))
+    assert s.dims.n_servers == 5
+    assert s.bounds.max_term == 4 and s.bounds.max_log_len == 4
+    assert s.backend == {"BATCH": 8192, "QUEUE_CAPACITY": 1 << 22,
+                         "SEEN_CAPACITY": 1 << 25, "N_MSG_SLOTS": 48,
+                         "CHECKPOINT_INTERVAL": 300}
+    assert s.dims.n_msg_slots == 48        # backend key reached dims
+    # CLI flag wins over the directive.
+    s2 = load_config(os.path.join(REPO, "configs/TPUraft.cfg"),
+                     n_msg_slots=40)
+    assert s2.dims.n_msg_slots == 40
+
+
+def test_unknown_backend_key_raises(tmp_path):
+    cfgf = tmp_path / "bad.cfg"
+    cfgf.write_text("\\* TPU: BOGUS_KEY = 1\n"
+                    "CONSTANT Server = {r1}\nCONSTANT Value = {v1}\n")
+    with pytest.raises(ValueError, match="BOGUS_KEY"):
+        load_config(str(cfgf))
+
+
+def test_reference_cfgs_have_no_backend_keys():
+    assert load_config(f"{REF}/MCraft.cfg").backend == {}
+
+
+def test_backend_directives_reach_engine_config():
+    """API precedence: run_check/make_engine honor backend keys when no
+    explicit EngineConfig is supplied (not just the CLI path)."""
+    from raft_tla_tpu.engine.check import engine_config_from_backend
+    s = load_config(os.path.join(REPO, "configs/TPUraft.cfg"))
+    ec = engine_config_from_backend(s)
+    assert ec.batch == 8192
+    assert ec.queue_capacity == 1 << 22
+    assert ec.seen_capacity == 1 << 25
+    assert ec.checkpoint_interval_seconds == 300.0

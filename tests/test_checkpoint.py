@@ -78,7 +78,7 @@ def test_checkpoint_roundtrip_and_dims_guard(tmp_path):
     # Keys are stored lex-sorted (resume pads them straight into the FPSet).
     keys = (ck.seen_hi.astype(np.uint64) << np.uint64(32)) \
         | ck.seen_lo.astype(np.uint64)
-    assert (np.diff(keys.astype(np.int64)) > 0).all()
+    assert (keys[1:] > keys[:-1]).all()   # unsigned compare: no diff overflow
     assert ck.roots  # the Init root travels with the snapshot
 
     other = BFSEngine(
@@ -87,3 +87,26 @@ def test_checkpoint_roundtrip_and_dims_guard(tmp_path):
                             seen_capacity=1 << 10))
     with pytest.raises(ValueError, match="dims"):
         other.run(resume=path)
+
+
+def test_mixed_mode_resume_guards(tmp_path):
+    """A trace-off resume must not shadow trace-carrying snapshots with
+    empty-trace ones in the same directory, and a trace-on resume of a
+    trace-less checkpoint must fail fast (replay could never reach a root)."""
+    ckdir = str(tmp_path / "states")
+    eng = make_engine(checkpoint_dir=ckdir, max_diameter=2)
+    eng.run([init_state(DIMS)])
+    path = ckpt_mod.latest(ckdir)
+
+    with pytest.raises(ValueError, match="trace-less snapshots"):
+        make_engine(record_trace=False, checkpoint_dir=ckdir).run(resume=path)
+    # Without a checkpoint dir there is nothing to poison: allowed.
+    r = make_engine(record_trace=False, max_diameter=3).run(resume=path)
+    assert r.diameter == 3
+
+    ckdir2 = str(tmp_path / "states_notrace")
+    eng2 = make_engine(record_trace=False, checkpoint_dir=ckdir2,
+                       max_diameter=2)
+    eng2.run([init_state(DIMS)])
+    with pytest.raises(ValueError, match="restart from scratch"):
+        make_engine().run(resume=ckpt_mod.latest(ckdir2))
