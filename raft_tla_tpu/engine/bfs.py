@@ -7,10 +7,9 @@ to one XLA program per step:
     slice B states off the current-level queue
       -> vmap(expand): all G action instances of all B states   [B,G]
       -> vmap(fingerprint) over the B*G candidates
-      -> sort-based in-batch dedup (two-key lax.sort)
-      -> binary-search probe of the sorted FPSet
-      -> merge new fingerprints; scatter new+constraint-passing states
-         into the next-level queue
+      -> batched hash-table insert (ops/fpset.py): one pass that dedups
+         the batch AND probes/updates the HBM seen-set — no sorts
+      -> scatter new+constraint-passing states into the next-level queue
       -> invariant ids, deadlock mask, violation/overflow reporting
 
 Everything device-resident: the two level queues (flat int32 state rows),
@@ -65,6 +64,7 @@ class EngineConfig:
     seen_capacity: int = 1 << 18
     check_deadlock: bool = True
     record_trace: bool = True
+    sync_every: int = 32         # device batches per host round-trip
     max_seconds: Optional[float] = None   # StopAfter duration budget
     max_diameter: Optional[int] = None    # StopAfter diameter budget
     checkpoint_dir: Optional[str] = None  # R8: level-boundary snapshots
@@ -127,21 +127,21 @@ class BFSEngine:
         Q = -(-cfg.queue_capacity // B) * B
         self._sw, self._B, self._G, self._Q = sw, B, G, Q
 
-        def absorb(crows, cands, en, parent_hi, parent_lo, actions,
+        def absorb(crows, en, parent_hi, parent_lo, actions,
                    qnext, next_count, seen):
-            """Shared tail: dedup candidates against batch+FPSet, merge,
-            enqueue, report.  ``crows`` [K,SW] flat rows, ``cands`` the
-            matching StateBatch pytree, ``en`` [K] validity."""
+            """Shared tail: hash-insert candidates (which both dedups the
+            batch and probes/updates the FPSet in one pass — no sorts),
+            enqueue, report.  ``crows`` [K,SW] flat rows, ``en`` [K]
+            validity.  The StateBatch views are re-sliced from ``crows`` so
+            the rows are the only materialized candidate buffer."""
             k = crows.shape[0]
+            cands = jax.vmap(unflatten_state, (0, None))(crows, dims)
             fph, fpl = jax.vmap(fingerprint)(cands)
-            (sh, sl), order, first = fpset.dedup_batch(fph, fpl, en)
-            in_seen = fpset.contains(seen, sh, sl)
-            new = first & ~in_seen
-            seen = fpset.merge(seen, sh, sl, new)
+            seen, new, fail = fpset.insert(seen, fph, fpl, en)
             n_new = jnp.sum(new, dtype=_I32)
 
             if inv_fns:
-                inv = jax.vmap(build_inv_id(inv_fns))(cands)[order]
+                inv = jax.vmap(build_inv_id(inv_fns))(cands)
             else:
                 inv = jnp.full((k,), -1, _I32)
             viol = new & (inv >= 0)
@@ -149,14 +149,13 @@ class BFSEngine:
             vpos = jnp.argmax(viol)
 
             if constraint is not None:
-                cons_ok = jax.vmap(constraint)(cands)[order]
+                cons_ok = jax.vmap(constraint)(cands)
             else:
                 cons_ok = jnp.ones((k,), bool)
             enq = new & cons_ok
-            crows_s = crows[order]
             pos = next_count + jnp.cumsum(enq.astype(_I32)) - 1
             pos = jnp.where(enq, pos, Q)
-            qnext = qnext.at[pos].set(crows_s, mode="drop")
+            qnext = qnext.at[pos].set(crows, mode="drop")
             next_count = next_count + jnp.sum(enq, dtype=_I32)
 
             # Compacted trace records for the n_new fresh states.
@@ -165,55 +164,142 @@ class BFSEngine:
             def compact(x):
                 return jnp.zeros((k,), x.dtype).at[tpos].set(x, mode="drop")
 
-            tr = (compact(sh), compact(sl),
-                  compact(parent_hi[order]), compact(parent_lo[order]),
-                  compact(actions[order]))
-            vinfo = (viol_any, inv[vpos], crows_s[vpos], sh[vpos], sl[vpos])
-            return qnext, next_count, seen, n_new, tr, vinfo
+            tr = (compact(fph), compact(fpl),
+                  compact(parent_hi), compact(parent_lo), compact(actions))
+            vinfo = (viol_any, inv[vpos], crows[vpos], fph[vpos], fpl[vpos])
+            return qnext, next_count, seen, n_new, fail, tr, vinfo
 
-        def step(qcur, cur_count, offset, qnext, next_count, seen):
+        def ingest(rows, valid, qnext, next_count, seen):
+            sent = jnp.zeros(rows.shape[:1], jnp.uint32)
+            acts = jnp.full(rows.shape[:1], -1, _I32)
+            return absorb(rows, valid, sent, sent, acts,
+                          qnext, next_count, seen)
+
+        # -- the device-resident level loop --------------------------------
+        # One host round-trip over the TPU tunnel costs orders of magnitude
+        # more than one batch of device work, so the per-level batch loop
+        # runs ON DEVICE as a lax.while_loop processing up to
+        # ``sync_every`` batches per call, accumulating every scalar the
+        # host needs into ONE packed int32 stats vector (a single fetch).
+        # Trace records accumulate in a device buffer flushed per chunk.
+        # The loop exits early on violation / deadlock / overflow /
+        # trace-buffer pressure; the host inspects the packed stats and
+        # fetches the few relevant rows only when a flag is set.
+        CH = max(1, cfg.sync_every)
+        # Trace-buffer rows: enough that a fresh chunk (tcount=0) always
+        # has room for >= 1 batch, else the loop could make no progress.
+        TQ = Q + B * G
+        check_deadlock_static = cfg.check_deadlock
+
+        def chunk_body(qcur, cur_count, carry):
+            (offset, steps, qnext, next_count, seen, tbuf, tcount,
+             gen, newc, ovfc, dead_any, drow, viol_any, vinv, vrow,
+             vhi, vlo, fail_any) = carry
             rows = jax.lax.dynamic_slice_in_dim(qcur, offset, B, axis=0)
             valid = (offset + jnp.arange(B, dtype=_I32)) < cur_count
             states = jax.vmap(unflatten_state, (0, None))(rows, dims)
             cands, en, ovf = jax.vmap(expand)(states)
             en = en & valid[:, None]
             ovf = ovf & valid[:, None]
-            dead = valid & ~jnp.any(en, axis=1) & ~jnp.any(ovf, axis=1)
-            dead_any = jnp.any(dead)
-            drow = rows[jnp.argmax(dead)]
+            dead_b = valid & ~jnp.any(en, axis=1) & ~jnp.any(ovf, axis=1)
+            dead_any_b = jnp.any(dead_b)
+            drow_b = rows[jnp.argmax(dead_b)]
 
             cflat = jax.tree.map(
                 lambda a: a.reshape((B * G,) + a.shape[2:]), cands)
             crows = jax.vmap(flatten_state, (0, None))(cflat, dims)
-            php, plp = jax.vmap(fingerprint)(states)       # parent fps [B]
+            php, plp = jax.vmap(fingerprint)(states)     # parent fps [B]
             k_idx = jnp.arange(B * G, dtype=_I32)
             parent_hi = php[k_idx // G]
             parent_lo = plp[k_idx // G]
             actions = k_idx % G
 
-            qnext, next_count, seen, n_new, tr, vinfo = absorb(
-                crows, cflat, en.reshape(-1), parent_hi, parent_lo, actions,
-                qnext, next_count, seen)
-            stats = (n_new, jnp.sum(en, dtype=_I32),
-                     jnp.sum(ovf, dtype=_I32), dead_any)
-            return qnext, next_count, seen, stats, tr, vinfo, drow
+            cands2 = jax.vmap(unflatten_state, (0, None))(crows, dims)
+            fph, fpl = jax.vmap(fingerprint)(cands2)
+            enf = en.reshape(-1)
+            seen, new, fail = fpset.insert(seen, fph, fpl, enf)
 
-        def ingest(rows, valid, qnext, next_count, seen):
-            states = jax.vmap(unflatten_state, (0, None))(rows, dims)
-            sent = jnp.zeros(rows.shape[:1], jnp.uint32)
-            acts = jnp.full(rows.shape[:1], -1, _I32)
-            return absorb(rows, states, valid, sent, sent, acts,
-                          qnext, next_count, seen)
+            if inv_fns:
+                inv = jax.vmap(build_inv_id(inv_fns))(cands2)
+            else:
+                inv = jnp.full((B * G,), -1, _I32)
+            viol = new & (inv >= 0)
+            viol_any_b = jnp.any(viol)
+            vpos = jnp.argmax(viol)
+
+            if constraint is not None:
+                cons_ok = jax.vmap(constraint)(cands2)
+            else:
+                cons_ok = jnp.ones((B * G,), bool)
+            enq = new & cons_ok
+            pos = next_count + jnp.cumsum(enq.astype(_I32)) - 1
+            pos = jnp.where(enq, pos, Q)
+            qnext = qnext.at[pos].set(crows, mode="drop")
+            next_count = next_count + jnp.sum(enq, dtype=_I32)
+
+            tpos = jnp.where(new, tcount + jnp.cumsum(new.astype(_I32)) - 1,
+                             TQ)
+            tbuf = tuple(
+                buf.at[tpos].set(col, mode="drop")
+                for buf, col in zip(
+                    tbuf, (fph, fpl, parent_hi, parent_lo, actions)))
+            tcount = tcount + jnp.sum(new, dtype=_I32)
+
+            take_v = ~viol_any & viol_any_b
+            vinv = jnp.where(take_v, inv[vpos], vinv)
+            vrow = jnp.where(take_v, crows[vpos], vrow)
+            vhi = jnp.where(take_v, fph[vpos], vhi)
+            vlo = jnp.where(take_v, fpl[vpos], vlo)
+            drow = jnp.where(dead_any | ~dead_any_b, drow, drow_b)
+            return (offset + B, steps + 1, qnext, next_count, seen, tbuf,
+                    tcount, gen + jnp.sum(en, dtype=_I32),
+                    newc + jnp.sum(new, dtype=_I32),
+                    ovfc + jnp.sum(ovf, dtype=_I32),
+                    dead_any | dead_any_b, drow,
+                    viol_any | viol_any_b, vinv, vrow, vhi, vlo,
+                    fail_any | fail)
+
+        def chunk(qcur, cur_count, offset0, qnext, next_count, seen,
+                  tbuf, tcount0):
+            init = (offset0, jnp.int32(0), qnext, next_count, seen, tbuf,
+                    tcount0, jnp.int32(0), jnp.int32(0), jnp.int32(0),
+                    jnp.bool_(False), jnp.zeros((sw,), _I32),
+                    jnp.bool_(False), jnp.int32(-1), jnp.zeros((sw,), _I32),
+                    jnp.uint32(0), jnp.uint32(0), jnp.bool_(False))
+
+            def cond(c):
+                (offset, steps, _qn, next_count, _seen, _tb, tcount,
+                 _g, _n, ovfc, dead_any, _dr, viol_any, _vi, _vr, _vh,
+                 _vl, fail_any) = c
+                more = (offset < cur_count) & (steps < CH)
+                room = tcount <= TQ - B * G
+                stop = viol_any | (ovfc > 0) | fail_any
+                if check_deadlock_static:
+                    stop = stop | dead_any
+                return more & room & ~stop
+
+            out = jax.lax.while_loop(
+                cond, lambda c: chunk_body(qcur, cur_count, c), init)
+            (offset, steps, qnext, next_count, seen, tbuf, tcount,
+             gen, newc, ovfc, dead_any, drow, viol_any, vinv, vrow,
+             vhi, vlo, fail_any) = out
+            stats = jnp.stack([
+                offset, steps, next_count, seen.size, tcount, gen, newc,
+                ovfc, dead_any.astype(_I32), viol_any.astype(_I32), vinv,
+                fail_any.astype(_I32)])
+            return (qnext, seen, tbuf, stats, drow, vrow,
+                    jnp.stack([vhi, vlo]))
 
         def fp_rows(rows):
             return jax.vmap(fingerprint)(
                 jax.vmap(unflatten_state, (0, None))(rows, dims))
 
-        self._step = jax.jit(step, donate_argnums=(3, 5))
+        self._chunk = jax.jit(chunk, donate_argnums=(3, 5, 6))
         self._ingest = jax.jit(ingest, donate_argnums=(2, 4))
         self._fp_rows = jax.jit(fp_rows)
         self._expand1 = jax.jit(expand)
         self._fp_batch = jax.jit(jax.vmap(fingerprint))
+        self._TQ = TQ
 
     # ------------------------------------------------------------------
     def run(self, init_states: Optional[List[PyState]] = None,
@@ -243,37 +329,34 @@ class BFSEngine:
         qnext = jnp.zeros((Q, sw), _I32)
         seen = fpset.empty(cfg.seen_capacity)
         next_count = jnp.int32(0)
+        TQ = self._TQ
+        tbuf = (jnp.zeros((TQ,), jnp.uint32), jnp.zeros((TQ,), jnp.uint32),
+                jnp.zeros((TQ,), jnp.uint32), jnp.zeros((TQ,), jnp.uint32),
+                jnp.zeros((TQ,), _I32))
 
         # Warm-up: run both programs once with empty inputs (no semantic
-        # effect: all-invalid masks insert nothing) so XLA compilation does
-        # not count against the StopAfter duration budget — TLC's
-        # TLCGet("duration") measures checking, not compilation.
+        # effect: all-invalid masks insert nothing, zero-trip chunk) so XLA
+        # compilation does not count against the StopAfter duration budget —
+        # TLC's TLCGet("duration") measures checking, not compilation.
         out = self._ingest(jnp.zeros((B, sw), _I32), jnp.zeros((B,), bool),
                            qnext, next_count, seen)
         qnext, next_count, seen = out[0], out[1], out[2]
-        out = self._step(qcur, jnp.int32(0), jnp.int32(0),
-                         qnext, next_count, seen)
-        qnext, next_count, seen = out[0], out[1], out[2]
+        out = self._chunk(qcur, jnp.int32(0), jnp.int32(0),
+                          qnext, next_count, seen, tbuf, jnp.int32(0))
+        qnext, seen, tbuf = out[0], out[1], out[2]
         t0 = time.time()
 
         if resume is not None:
-            # Restore the level-boundary image: sentinel-pad the saved
-            # (sorted) FPSet keys back to capacity, reload the frontier,
-            # counters, and trace records/roots.
+            # Restore the level-boundary image: re-insert the saved keys
+            # into a fresh hash table, reload the frontier, counters, and
+            # trace records/roots.
             n_keys = resume.seen_hi.shape[0]
             if n_keys > cfg.seen_capacity:
                 raise RuntimeError(
                     f"checkpoint has {n_keys} seen keys > seen_capacity "
                     f"{cfg.seen_capacity}")
-            pad_n = cfg.seen_capacity - n_keys
-            seen = fpset.FPSet(
-                hi=jnp.concatenate([
-                    jnp.asarray(resume.seen_hi),
-                    jnp.full((pad_n,), fpset.SENTINEL, jnp.uint32)]),
-                lo=jnp.concatenate([
-                    jnp.asarray(resume.seen_lo),
-                    jnp.full((pad_n,), fpset.SENTINEL, jnp.uint32)]),
-                size=jnp.int32(n_keys))
+            seen = fpset.from_host_keys(resume.seen_hi, resume.seen_lo,
+                                        cfg.seen_capacity)
             fr = np.ascontiguousarray(resume.frontier, np.int32)
             if len(fr) > Q:
                 raise RuntimeError(
@@ -320,7 +403,8 @@ class BFSEngine:
                 chunk = rows_np[base:base + B]
                 pad = np.zeros((B - len(chunk), sw), np.int32)
                 valid = np.arange(B) < len(chunk)
-                qnext, next_count, seen, n_new, tr, vinfo = self._ingest(
+                (qnext, next_count, seen, n_new, fail, tr,
+                 vinfo) = self._ingest(
                     jnp.asarray(np.concatenate([chunk, pad])),
                     jnp.asarray(valid), qnext, next_count, seen)
                 res.distinct += int(n_new)
@@ -328,7 +412,7 @@ class BFSEngine:
                 if int(next_count) > Q:
                     raise RuntimeError(
                         "queue capacity exceeded by initial states")
-                if int(seen.size) > cfg.seen_capacity:
+                if bool(fail) or int(seen.size) > cfg.seen_capacity:
                     raise RuntimeError("seen-set capacity exceeded")
                 if self._check_violation(res, vinfo):
                     break
@@ -359,33 +443,51 @@ class BFSEngine:
                     and res.diameter >= cfg.max_diameter:
                 res.stop_reason = "diameter_budget"
                 break
+            # Level loop: each _chunk call runs up to sync_every batches on
+            # device; ONE packed stats fetch (plus a trace flush) per call
+            # is the only host traffic — the tunnel round-trip no longer
+            # bounds states/sec.
             offset = 0
+            next_count_h = 0
             while offset < cur_count:
-                qnext, next_count, seen, stats, tr, vinfo, drow = self._step(
-                    qcur, jnp.int32(cur_count), jnp.int32(offset),
-                    qnext, next_count, seen)
-                n_new, n_gen = int(stats[0]), int(stats[1])
-                n_ovf, dead_any = int(stats[2]), bool(stats[3])
+                out = self._chunk(qcur, jnp.int32(cur_count),
+                                  jnp.int32(offset), qnext,
+                                  jnp.int32(next_count_h), seen, tbuf,
+                                  jnp.int32(0))
+                qnext, seen, tbuf = out[0], out[1], out[2]
+                st = np.asarray(out[3])
+                offset, next_count_h = int(st[0]), int(st[2])
+                seen_size, tcount = int(st[3]), int(st[4])
+                n_gen, n_new, n_ovf = int(st[5]), int(st[6]), int(st[7])
+                dead_any, viol_any = bool(st[8]), bool(st[9])
+                vinv, fail = int(st[10]), bool(st[11])
+                res.distinct += n_new
+                res.generated += n_gen
+                if cfg.record_trace and tcount:
+                    self._flush_trace(trace, tbuf, tcount)
                 if n_ovf:
                     raise RuntimeError(
                         f"{n_ovf} successors exceeded fixed-width capacity "
                         f"(max_log={dims.max_log}, n_msg_slots="
                         f"{dims.n_msg_slots}); rerun with larger capacities")
-                res.distinct += n_new
-                res.generated += n_gen
-                self._record(trace, tr, n_new)
-                if int(seen.size) > cfg.seen_capacity:
+                if fail or seen_size > cfg.seen_capacity:
                     raise RuntimeError("seen-set capacity exceeded")
-                if int(next_count) > Q:
+                if next_count_h > Q:
                     raise RuntimeError("queue capacity exceeded")
-                if self._check_violation(res, vinfo):
+                if viol_any:
+                    vrow, vhl = np.asarray(out[5]), np.asarray(out[6])
+                    res.violation = Violation(
+                        invariant=self.inv_names[vinv],
+                        state=decode_state(
+                            unflatten_state(vrow, dims), dims),
+                        fingerprint=(int(vhl[0]) << 32) | int(vhl[1]))
+                    res.stop_reason = "violation"
                     break
                 if dead_any and cfg.check_deadlock:
                     res.deadlock = decode_state(
-                        unflatten_state(np.asarray(drow), dims), dims)
+                        unflatten_state(np.asarray(out[4]), dims), dims)
                     res.stop_reason = "deadlock"
                     break
-                offset += B
                 if (cfg.max_seconds is not None
                         and time.time() - t0 > cfg.max_seconds):
                     res.stop_reason = "duration_budget"
@@ -393,9 +495,9 @@ class BFSEngine:
             if res.stop_reason != "exhausted" or res.violation is not None:
                 break  # aborted mid-level: diameter counts completed levels
             res.diameter += 1
-            res.levels.append(int(next_count))
+            res.levels.append(next_count_h)
             qcur, qnext = qnext, qcur
-            cur_count = int(next_count)
+            cur_count = next_count_h
             next_count = jnp.int32(0)
 
         res.wall_seconds = time.time() - t0
@@ -472,6 +574,11 @@ class BFSEngine:
         parents = (ph.astype(np.uint64) << np.uint64(32)) \
             | pl.astype(np.uint64)
         trace.add_batch(fps, parents, ac)
+
+    def _flush_trace(self, trace, tbuf, tcount):
+        """Drain the device trace buffer (one chunk's records) to the host
+        store — one transfer per column slice."""
+        self._record(trace, tbuf, tcount)
 
     def _check_violation(self, res, vinfo) -> bool:
         viol_any, vinv, vrow, vhi, vlo = vinfo

@@ -47,6 +47,10 @@ def build_expand(dims: RaftDims):
     N, V, L, M, W = (dims.n_servers, dims.n_values, dims.max_log,
                      dims.n_msg_slots, dims.msg_width)
     i32 = jnp.int32
+    # Quorum evaluation dispatches through the dims hook so spec variants
+    # (models/reconfig.py joint consensus) change it without touching the
+    # kernels; the base spec is the simple majority of raft.tla:79-81.
+    quorum = dims.build_quorum()
 
     # -- helpers ----------------------------------------------------------
     def last_term(st: StateBatch, i):
@@ -136,9 +140,10 @@ def build_expand(dims: RaftDims):
         return en & ok, en & ~ok, new
 
     def become_leader(st: StateBatch, i):
-        """BecomeLeader(i) — raft.tla:195-203; quorum = simple majority :81."""
-        votes = jax.lax.population_count(st.votes_gran[i])
-        en = (st.role[i] == CANDIDATE) & (2 * votes > N)
+        """BecomeLeader(i) — raft.tla:195-203; quorum = simple majority :81
+        (or the variant's rule via dims.build_quorum)."""
+        member = ((st.votes_gran[i] >> jnp.arange(N, dtype=i32)) & 1) > 0
+        en = (st.role[i] == CANDIDATE) & quorum(st, i, member)
         new = st._replace(
             role=st.role.at[i].set(LEADER),
             next_idx=st.next_idx.at[i].set(
@@ -164,10 +169,10 @@ def build_expand(dims: RaftDims):
         en = st.role[i] == LEADER
         idxs = jnp.arange(1, L + 1, dtype=i32)                      # [L]
         # Agree(index) == {i} \cup {k : matchIndex[i][k] >= index}  :222-223
-        agree_cnt = jnp.sum(
-            (st.match_idx[i][None, :] >= idxs[:, None])
-            | (jnp.arange(N)[None, :] == i), axis=1)                # [L]
-        ok = (2 * agree_cnt > N) & (idxs <= st.log_len[i])          # :225-226
+        member = ((st.match_idx[i][None, :] >= idxs[:, None])
+                  | (jnp.arange(N)[None, :] == i))                  # [L,N]
+        ok = jax.vmap(lambda mem: quorum(st, i, mem))(member) \
+            & (idxs <= st.log_len[i])                               # :225-226
         any_ok = jnp.any(ok)
         max_agree = jnp.max(jnp.where(ok, idxs, 0))                 # Max :232
         own_term = st.log_term[i, jnp.clip(max_agree - 1, 0, L - 1)] \
@@ -319,6 +324,7 @@ def build_expand(dims: RaftDims):
     ci = jnp.repeat(jnp.arange(N, dtype=i32), V)
     cv = jnp.tile(jnp.arange(1, V + 1, dtype=i32), N)
     slots = jnp.arange(M, dtype=i32)
+    extra_kernels = dims.build_extra_kernels()
 
     def expand(st: StateBatch):
         """All candidate successors of one state.  Returns
@@ -336,6 +342,9 @@ def build_expand(dims: RaftDims):
             jax.vmap(duplicate, (None, 0))(st, slots),
             jax.vmap(drop, (None, 0))(st, slots),
         ]
+        for params, kern in extra_kernels:
+            in_axes = (None,) + (0,) * len(params)
+            outs.append(jax.vmap(kern, in_axes)(st, *params))
         enabled = jnp.concatenate([o[0] for o in outs])
         overflow = jnp.concatenate([o[1] for o in outs])
         cands = jax.tree.map(lambda *xs: jnp.concatenate(xs),

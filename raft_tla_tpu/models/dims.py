@@ -102,7 +102,67 @@ class RaftDims:
     @property
     def family_sizes(self) -> tuple:
         n, v, m = self.n_servers, self.n_values, self.n_msg_slots
-        return (n, n, n * n, n, n * v, n, n * n, m, m, m)
+        base = (n, n, n * n, n, n * v, n, n * n, m, m, m)
+        return base + tuple(sz for _name, sz in self.extra_families)
+
+    @property
+    def family_names(self) -> tuple:
+        return FAMILY_NAMES + tuple(nm for nm, _sz in self.extra_families)
+
+    # -- model-variant hooks ----------------------------------------------
+    # A spec variant (e.g. models/reconfig.py's joint-consensus extension)
+    # subclasses RaftDims and overrides these; the JAX kernels
+    # (models/actions.py), the Python oracle (models/oracle.py), and the
+    # invariants (models/invariants.py) all dispatch through them, so every
+    # engine (single-chip BFS, mesh BFS, simulation) picks up a variant
+    # just by being handed its dims.
+
+    @property
+    def extra_families(self) -> tuple:
+        """Extra action families beyond the raft.tla:421-430 alphabet:
+        tuple of (name, instance_count)."""
+        return ()
+
+    def build_quorum(self):
+        """JAX kernel ``quorum(state, i, member) -> bool`` deciding whether
+        the [N]-bool ``member`` vector is a quorum from server i's point of
+        view.  Base spec: simple majority of Server (raft.tla:79-81)."""
+        import jax.numpy as jnp
+        n = self.n_servers
+
+        def quorum(st, i, member):
+            return 2 * jnp.sum(member.astype(jnp.int32)) > n
+
+        return quorum
+
+    def quorum_py(self, s, i: int, mask: int) -> bool:
+        """Oracle-side quorum on a membership bitmask (raft.tla:81)."""
+        return 2 * bin(mask).count("1") > self.n_servers
+
+    def build_extra_kernels(self):
+        """JAX kernels for the extra families, in ``extra_families`` order:
+        list of (param_arrays, kernel) with
+        ``kernel(state, *params) -> (enabled, overflow, state')``."""
+        return []
+
+    def extra_successors_py(self, s):
+        """Oracle-side successors for the extra families: iterable of
+        ((family_code, params), successor_state)."""
+        return ()
+
+    def build_value_ok(self):
+        """JAX elementwise predicate: is a log-entry value lane well-typed
+        (entries in Value — raft.tla:456/:465)?  Variants widen this."""
+        import jax.numpy as jnp
+        v = self.n_values
+
+        def value_ok(vals):
+            return (vals >= 1) & (vals <= v)
+
+        return value_ok
+
+    def value_ok_py(self, val: int) -> bool:
+        return 1 <= val <= self.n_values
 
     @property
     def family_offsets(self) -> tuple:
@@ -136,4 +196,5 @@ class RaftDims:
 
     def describe_instance(self, g: int) -> str:
         fam, p = self.instance_info(g)
-        return f"{FAMILY_NAMES[fam]}({', '.join(f'{k}={v}' for k, v in p.items())})"
+        name = self.family_names[fam]
+        return f"{name}({', '.join(f'{k}={v}' for k, v in p.items())})"

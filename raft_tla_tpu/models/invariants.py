@@ -36,7 +36,8 @@ from .schema import StateBatch
 
 
 def build_type_ok(dims: RaftDims):
-    N, V, L = dims.n_servers, dims.n_values, dims.max_log
+    N, L = dims.n_servers, dims.max_log
+    value_ok = dims.build_value_ok()     # entries-in-Value, variant-widened
 
     def type_ok(st: StateBatch):
         lane = jnp.arange(L)[None, :]
@@ -47,8 +48,8 @@ def build_type_ok(dims: RaftDims):
         checks = [
             jnp.all((st.role >= 0) & (st.role <= 2)),
             jnp.all((st.voted_for >= 0) & (st.voted_for <= N)),
-            jnp.all(jnp.where(in_log, (st.log_term >= 0)
-                              & (st.log_val >= 1) & (st.log_val <= V),
+            jnp.all(jnp.where(in_log,
+                              (st.log_term >= 0) & value_ok(st.log_val),
                               (st.log_term == 0) & (st.log_val == 0))),
             jnp.all((st.log_len >= 0) & (st.log_len <= L)),
             jnp.all(st.term >= 0) & jnp.all(st.commit >= 0),
@@ -74,10 +75,11 @@ def build_type_ok(dims: RaftDims):
 
 def type_ok_py(s: PyState, dims: RaftDims) -> bool:
     """Oracle-side TypeOK (subset mirroring build_type_ok's content checks)."""
-    n, v = dims.n_servers, dims.n_values
+    n = dims.n_servers
     ok = all(0 <= r <= 2 for r in s.role)
     ok &= all(0 <= vf <= n for vf in s.voted_for)
-    ok &= all(t >= 0 and 1 <= val <= v for log in s.log for (t, val) in log)
+    ok &= all(t >= 0 and dims.value_ok_py(val)
+              for log in s.log for (t, val) in log)
     ok &= all(t >= 0 for t in s.current_term)
     ok &= all(c >= 0 for c in s.commit_index)
     ok &= all(0 <= m < (1 << n)

@@ -103,8 +103,9 @@ def append_entries(s: PyState, dims: RaftDims, i: int, j: int) -> Optional[PySta
 
 
 def become_leader(s: PyState, dims: RaftDims, i: int) -> Optional[PyState]:
-    """BecomeLeader(i) — raft.tla:195-203."""
-    if s.role[i] != CANDIDATE or not quorum(s.votes_granted[i], dims.n_servers):
+    """BecomeLeader(i) — raft.tla:195-203 (quorum via dims.quorum_py, so
+    spec variants like joint consensus plug in their rule)."""
+    if s.role[i] != CANDIDATE or not dims.quorum_py(s, i, s.votes_granted[i]):
         return None
     n = dims.n_servers
     return s.replace(
@@ -130,8 +131,9 @@ def advance_commit_index(s: PyState, dims: RaftDims, i: int) -> Optional[PyState
     log_i = s.log[i]
 
     def agree(index: int) -> bool:
-        agreers = {i} | {k for k in range(n) if s.match_index[i][k] >= index}
-        return 2 * len(agreers) > n                           # :222-226
+        mask = (1 << i) | sum(
+            1 << k for k in range(n) if s.match_index[i][k] >= index)
+        return dims.quorum_py(s, i, mask)                     # :222-226
 
     agree_indexes = [idx for idx in range(1, len(log_i) + 1) if agree(idx)]
     if agree_indexes and log_i[max(agree_indexes) - 1][0] == s.current_term[i]:
@@ -303,6 +305,7 @@ def successors(s: PyState, dims: RaftDims) -> List[Tuple[Action, PyState]]:
         add(A_RECEIVE, (m,), receive(s, dims, m))
         add(A_DUPLICATE, (m,), duplicate_message(s, m))
         add(A_DROP, (m,), drop_message(s, m))
+    out.extend(dims.extra_successors_py(s))   # spec-variant families
     return out
 
 

@@ -1,0 +1,266 @@
+"""Joint-consensus membership reconfiguration — the configs[4] spec variant.
+
+The reference spec models a *fixed* membership (``Server`` is constant —
+/root/reference/raft.tla:11, and the changelog note at raft.tla:1188-1190
+says membership changes were removed from the dissertation spec).  The
+BASELINE.json target list nonetheless names "Raft + joint-consensus
+reconfiguration (dynamic membership) state space" as a checking
+configuration, so this module extends the transition system with the Raft
+paper's joint-consensus (C_old,new) scheme, the way a TLA+ author would
+extend the module — new log-entry kind + two new actions — while every
+existing action stays textually untouched (they dispatch through the
+``RaftDims`` variant hooks).
+
+Modeling rules (standard joint consensus):
+
+- **Configurations ride in the log.**  A config entry's value encodes one
+  or two membership bitmasks: ``CFG_BASE + (old << 8) + new`` is the joint
+  configuration C_old,new, and ``CFG_BASE + new`` (old bits zero) is a
+  final configuration C_new.  Client values 1..V are untouched, so config
+  entries replicate, conflict, and truncate through ``AppendEntries``
+  exactly like any other entry — no new message machinery.
+- **A server uses the latest configuration in its log** (committed or not;
+  the Raft rule), falling back to the initial full membership when its log
+  has none.  Truncation by ``ConflictAppendEntriesRequest`` reverts it.
+- **Quorums**: under a joint configuration, elections and commitment both
+  require a majority of C_old *and* a majority of C_new; under a final
+  configuration, a majority of that configuration.  This replaces the
+  simple-majority ``Quorum`` (raft.tla:79-81) via ``build_quorum``/
+  ``quorum_py``.
+- **InitiateReconfig(i, c)**: a leader whose current configuration is
+  final (no change in progress — the one-at-a-time rule) appends the joint
+  entry C_current,c for a target configuration ``c != current``.
+- **FinalizeReconfig(i)**: a leader whose current configuration is the
+  joint C_old,new *and whose commitIndex has reached that entry* appends
+  the final entry C_new.
+- Deliberately permissive (like the base spec): servers outside the
+  current configuration still time out, campaign, and vote — their votes
+  simply only count toward quorums of configurations that include them;
+  a leader excluded by C_new keeps acting until some other action (e.g.
+  a higher term) displaces it.  Allowed target configurations are the
+  model constant ``TargetConfigs`` (a finite set of bitmasks), the
+  analogue of binding ``Server``/``Value`` in MCraft.tla:15-21.
+
+The state schema, fingerprints, and engines are unchanged: a
+``ReconfigDims`` is a ``RaftDims`` whose hooks widen the action grid, the
+quorum rule, and the TypeOK value domain.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Tuple
+
+from .dims import LEADER, RaftDims
+
+# Log-entry values >= CFG_BASE are configuration entries; below are client
+# values.  Layout: CFG_BASE + (old_mask << 8) + new_mask, old_mask == 0
+# meaning a final (non-joint) configuration.  Masks fit 8 bits (N <= 8).
+CFG_BASE = 1 << 12
+
+A_INITRECONFIG = 10
+A_FINALIZE = 11
+
+
+def joint_value(old_mask: int, new_mask: int) -> int:
+    """Log value of the joint entry C_old,new."""
+    return CFG_BASE + (old_mask << 8) + new_mask
+
+
+def final_value(new_mask: int) -> int:
+    """Log value of the final entry C_new."""
+    return CFG_BASE + new_mask
+
+
+def config_of_py(log, n: int) -> Tuple[int, int, int]:
+    """(old_mask, new_mask, index) of the latest config entry in ``log``;
+    old_mask == 0 means final.  Default: initial full membership at
+    index 0."""
+    for idx in range(len(log), 0, -1):
+        v = log[idx - 1][1]
+        if v >= CFG_BASE:
+            enc = v - CFG_BASE
+            return (enc >> 8) & 0xFF, enc & 0xFF, idx
+    return 0, (1 << n) - 1, 0
+
+
+@dataclasses.dataclass(frozen=True)
+class ReconfigDims(RaftDims):
+    """RaftDims + joint-consensus reconfiguration over ``targets`` (the
+    TargetConfigs membership bitmasks a leader may move to)."""
+
+    targets: Tuple[int, ...] = ()
+
+    def __post_init__(self):
+        super().__post_init__()
+        full = (1 << self.n_servers) - 1
+        if not self.targets:
+            raise ValueError("ReconfigDims needs at least one target config")
+        for c in self.targets:
+            if not (1 <= c <= full):
+                raise ValueError(
+                    f"target config {c:#x} not a nonempty subset of the "
+                    f"{self.n_servers} servers")
+
+    # -- grid -------------------------------------------------------------
+    @property
+    def extra_families(self) -> tuple:
+        n, c = self.n_servers, len(self.targets)
+        return (("InitiateReconfig", n * c), ("FinalizeReconfig", n))
+
+    def instance_info(self, g: int) -> tuple:
+        base = sum(sz for _n, sz in zip(
+            range(10), RaftDims.family_sizes.fget(self)[:10]))
+        if g < base:
+            return super().instance_info(g)
+        k = g - base
+        nc = self.n_servers * len(self.targets)
+        if k < nc:
+            i, t = divmod(k, len(self.targets))
+            return A_INITRECONFIG, {"i": i, "c": self.targets[t]}
+        k -= nc
+        if k < self.n_servers:
+            return A_FINALIZE, {"i": k}
+        raise IndexError(g)
+
+    # -- quorum (joint rule) ----------------------------------------------
+    def build_quorum(self):
+        import jax.numpy as jnp
+
+        config_scan = _build_config_scan(self)
+        N = self.n_servers
+
+        def maj(member, mask):
+            bits = ((mask >> jnp.arange(N, dtype=jnp.int32)) & 1) > 0
+            return (2 * jnp.sum((member & bits).astype(jnp.int32))
+                    > jnp.sum(bits.astype(jnp.int32)))
+
+        def quorum(st, i, member):
+            old, new, _idx = config_scan(st, i)
+            return jnp.where(old > 0, maj(member, old) & maj(member, new),
+                             maj(member, new))
+
+        return quorum
+
+    def quorum_py(self, s, i: int, mask: int) -> bool:
+        old, new, _idx = config_of_py(s.log[i], self.n_servers)
+
+        def maj(cfg: int) -> bool:
+            return 2 * bin(mask & cfg).count("1") > bin(cfg).count("1")
+
+        return (maj(old) and maj(new)) if old else maj(new)
+
+    # -- new actions ------------------------------------------------------
+    def build_extra_kernels(self):
+        import jax.numpy as jnp
+
+        config_scan = _build_config_scan(self)
+        N, L = self.n_servers, self.max_log
+        i32 = jnp.int32
+
+        def append_entry(st, i, val):
+            ln = st.log_len[i]
+            kpos = jnp.clip(ln, 0, L - 1)
+            return ln < L, st._replace(
+                log_term=st.log_term.at[i, kpos].set(st.term[i]),
+                log_val=st.log_val.at[i, kpos].set(val),
+                log_len=st.log_len.at[i].add(1))
+
+        def initiate(st, i, c):
+            """Leader with a final config appends C_current,c."""
+            old, new, _idx = config_scan(st, i)
+            en = (st.role[i] == LEADER) & (old == 0) & (c != new)
+            fits, new_st = append_entry(
+                st, i, CFG_BASE + (new << 8) + c)
+            return en & fits, en & ~fits, new_st
+
+        def finalize(st, i):
+            """Leader whose committed joint config C_old,new appends
+            C_new."""
+            old, new, idx = config_scan(st, i)
+            en = (st.role[i] == LEADER) & (old > 0) & (st.commit[i] >= idx)
+            fits, new_st = append_entry(st, i, CFG_BASE + new)
+            return en & fits, en & ~fits, new_st
+
+        targets = jnp.asarray(self.targets, i32)
+        c_count = len(self.targets)
+        ii = jnp.repeat(jnp.arange(N, dtype=i32), c_count)
+        cc = jnp.tile(targets, N)
+        servers = jnp.arange(N, dtype=i32)
+        return [((ii, cc), initiate), ((servers,), finalize)]
+
+    def extra_successors_py(self, s):
+        n = self.n_servers
+        out = []
+        for i in range(n):
+            if s.role[i] != LEADER:
+                continue
+            old, new, idx = config_of_py(s.log[i], n)
+            if old == 0:
+                for c in self.targets:
+                    if c != new:
+                        t = s.replace(log=_append(
+                            s.log, i, (s.current_term[i],
+                                       joint_value(new, c))))
+                        out.append(((A_INITRECONFIG, (i, c)), t))
+            elif s.commit_index[i] >= idx:
+                t = s.replace(log=_append(
+                    s.log, i, (s.current_term[i], final_value(new))))
+                out.append(((A_FINALIZE, (i,)), t))
+        return out
+
+    # -- TypeOK value domain ----------------------------------------------
+    def build_value_ok(self):
+        import jax.numpy as jnp
+
+        v, n = self.n_values, self.n_servers
+        full = (1 << n) - 1
+
+        def value_ok(vals):
+            client = (vals >= 1) & (vals <= v)
+            enc = vals - CFG_BASE
+            old = (enc >> 8) & 0xFF
+            new = enc & 0xFF
+            cfg = ((vals >= CFG_BASE)
+                   & (enc <= (full << 8) + full)
+                   & (new >= 1) & (new <= full) & (old <= full))
+            return client | cfg
+
+        return value_ok
+
+    def value_ok_py(self, val: int) -> bool:
+        if 1 <= val <= self.n_values:
+            return True
+        if val >= CFG_BASE:
+            enc = val - CFG_BASE
+            old, new = (enc >> 8) & 0xFF, enc & 0xFF
+            full = (1 << self.n_servers) - 1
+            return enc >> 16 == 0 and 1 <= new <= full and old <= full
+        return False
+
+
+def _build_config_scan(dims: "ReconfigDims"):
+    """JAX kernel: latest config entry of server i's log ->
+    (old_mask, new_mask, 1-based index); default (0, full, 0)."""
+    import jax.numpy as jnp
+
+    N, L = dims.n_servers, dims.max_log
+    i32 = jnp.int32
+    full = (1 << N) - 1
+
+    def config_scan(st, i):
+        vals = st.log_val[i]
+        lanes = jnp.arange(L, dtype=i32)
+        is_cfg = (lanes < st.log_len[i]) & (vals >= CFG_BASE)
+        has = jnp.any(is_cfg)
+        k = jnp.max(jnp.where(is_cfg, lanes, -1))
+        enc = vals[jnp.clip(k, 0, L - 1)] - CFG_BASE
+        old = jnp.where(has, (enc >> 8) & 0xFF, 0)
+        new = jnp.where(has, enc & 0xFF, full)
+        return old, new, jnp.where(has, k + 1, 0)
+
+    return config_scan
+
+
+def _append(logs, i, entry):
+    return logs[:i] + (logs[i] + (entry,),) + logs[i + 1:]

@@ -1,74 +1,121 @@
-"""The seen-state set — TLC's FPSet rebuilt as a sorted HBM array.
+"""The seen-state set — TLC's FPSet rebuilt as an HBM open-addressing table.
 
 TLC keeps seen-state fingerprints in an in-memory/disk hash set probed one
-state at a time [TLC semantics — external].  A TPU wants the opposite shape:
-**batched, sort-based, branch-free**.  This FPSet is a fixed-capacity pair of
-uint32 arrays (the two fingerprint lanes) kept lexicographically sorted, with
-all free space holding the all-ones sentinel (which sorts to the tail):
+state at a time [TLC semantics — external].  The first TPU port of this kept
+a lex-sorted array merged with a full ``lax.sort`` per step — but an 8M-key
+bitonic sort per batch is hundreds of full-array passes and dominated the
+whole engine.  This version is the SURVEY §2.4 R3 design proper: a
+fixed-capacity **open-addressing hash table resident in HBM** (double
+hashing rather than cuckoo eviction — eviction chains serialize badly under
+vmap, while bounded double-hash probing is a handful of static gather
+rounds), with a *batched parallel insert*:
 
-- ``contains``: vectorized lower-bound binary search — ``log2(C)`` gather
-  rounds over the whole query batch at once (XLA compiles this to a tight
-  fori loop; no data-dependent shapes);
-- ``merge``: concatenate + two-key ``lax.sort`` + slice.  Sorting is one of
-  the things XLA/TPU does extremely well, and a level-synchronous BFS only
-  merges once per level, so the amortized cost per state is tiny;
-- in-batch dedup of candidate fingerprints rides the same sort (payload =
-  original index, ``num_keys=2``).
+- each query key probes ``slot_k = (h1 + k*h2) mod C`` for a static number
+  of rounds, entirely with gathers/scatters — no data-dependent shapes;
+- per round, keys matching an occupied slot resolve as already-present;
+  keys over an empty slot stake a **claim** (scatter-max of the query index)
+  and exactly the claim winner writes, so concurrent inserts of different
+  keys never interleave and the table is deterministic;
+- losers re-read the slot after the write (catching same-key duplicates in
+  the same batch — the winner's key is now visible) and only then advance
+  to their next probe slot.
 
-Capacity is static; the engine host-checks ``size`` and raises before
-overflow — a checker must never silently forget states.
+Insert therefore also performs the *in-batch dedup* that previously needed
+a candidate-wide sort: exactly one query per distinct new key reports
+``is_new``.  Cost per batch is O(rounds × batch), independent of table
+capacity; the old design's O(C log^2 C) sort is gone.
+
+``size`` counts stored keys; a query still unresolved after all probe
+rounds sets the ``fail`` flag (table effectively full for that
+neighborhood) — the engine raises rather than ever silently dropping a
+state.  Keep load below ~0.7 · capacity; the engines' capacity checks
+enforce a margin.
 """
 
 from __future__ import annotations
 
 from typing import NamedTuple, Tuple
 
-import jax
 import jax.numpy as jnp
 import numpy as np
 
-from .fingerprint import SENTINEL
+from .fingerprint import SENTINEL, fmix32
 
 _U32 = jnp.uint32
+_I32 = jnp.int32
+
+# Static probe rounds.  At load factor 0.7 the expected double-hash probe
+# count is ~1/(1-0.7) ≈ 3.3; 32 rounds puts the miss probability per query
+# around 0.7^32 ≈ 1e-5, and a miss is a *reported error*, never a lost state.
+PROBE_ROUNDS = 32
 
 
 class FPSet(NamedTuple):
-    hi: jnp.ndarray    # [C] uint32, lex-sorted (hi, lo), sentinel-padded
+    hi: jnp.ndarray    # [C] uint32 key lane; SENTINEL pair = empty slot
     lo: jnp.ndarray    # [C] uint32
-    size: jnp.ndarray  # [] int32 — number of real keys
+    size: jnp.ndarray  # [] int32 — number of stored keys
+
+
+def _capacity(requested: int) -> int:
+    """Table slots: next power of two >= requested (masked indexing)."""
+    c = 1
+    while c < requested:
+        c <<= 1
+    return c
 
 
 def empty(capacity: int) -> FPSet:
-    return FPSet(hi=jnp.full((capacity,), SENTINEL, _U32),
-                 lo=jnp.full((capacity,), SENTINEL, _U32),
+    c = _capacity(capacity)
+    return FPSet(hi=jnp.full((c,), SENTINEL, _U32),
+                 lo=jnp.full((c,), SENTINEL, _U32),
                  size=jnp.int32(0))
 
 
-def contains(s: FPSet, qhi, qlo):
-    """Membership for a batch of fingerprint pairs.  [K] bool."""
-    c = s.hi.shape[0]
-    lo_b = jnp.zeros(qhi.shape, jnp.int32)
-    hi_b = jnp.full(qhi.shape, c, jnp.int32)
-    steps = max(1, int(np.ceil(np.log2(c + 1))) + 1)
-    for _ in range(steps):                       # static unroll: log2(C)
-        mid = (lo_b + hi_b) >> 1
-        mh, ml = s.hi[mid], s.lo[mid]
-        less = (mh < qhi) | ((mh == qhi) & (ml < qlo))
-        lo_b = jnp.where(less, mid + 1, lo_b)
-        hi_b = jnp.where(less, hi_b, mid)
-    at = jnp.clip(lo_b, 0, c - 1)
-    return (s.hi[at] == qhi) & (s.lo[at] == qlo) & (lo_b < c)
+def _probe_base(qhi, qlo, c):
+    """(h1, h2) for double hashing; h2 odd => full cycle over power-of-2 C."""
+    h1 = fmix32(qhi ^ fmix32(qlo ^ _U32(0x9E3779B9)))
+    h2 = fmix32(qlo ^ fmix32(qhi ^ _U32(0x85EBCA6B))) | _U32(1)
+    return h1 & _U32(c - 1), h2
+
+
+# TPU gather/scatter performance is shape-sensitive in two ways this module
+# must design around (measured on v5e through the serving tunnel):
+# 1. a gather where a large fraction of lanes reads the SAME address (e.g.
+#    every invalid query probing the sentinel key's slot) serializes on the
+#    hot address — 0.05ms becomes 300ms;
+# 2. non-power-of-two query batches hit a slow lowering (270336 lanes is
+#    4000x slower than 262144 for the identical gather).
+# Hence: every probing entry point pads its query batch to a power of two,
+# and inactive lanes probe a per-lane spread address instead of a shared
+# one.  Both transformations are semantically invisible.
+
+def _pow2(n: int) -> int:
+    p = 1
+    while p < n:
+        p <<= 1
+    return p
+
+
+def _pad_pow2(arrs, fill):
+    k = arrs[0].shape[0]
+    kp = _pow2(k)
+    if kp == k:
+        return arrs, k
+    return tuple(jnp.concatenate(
+        [a, jnp.full((kp - k,), f, a.dtype)]) for a, f in zip(arrs, fill)), k
 
 
 def dedup_batch(khi, klo, valid):
-    """In-batch first-occurrence marking.  Returns ((sorted_hi, sorted_lo),
-    order, first_occ): the lex-sorted keys, the sort permutation (original
-    indices), and a mask marking the first occurrence of each distinct
-    non-sentinel key in sorted order."""
+    """In-batch first-occurrence marking via one (cheap) batch-sized sort.
+    Returns ((sorted_hi, sorted_lo), order, first_occ).  Duplicate keys are
+    *common* in a BFS batch (many parents generate the same successor), and
+    a TPU scatter serializes on colliding indices — so the table insert must
+    only ever see unique keys; this pre-pass guarantees that."""
     k = khi.shape[0]
     khi = jnp.where(valid, khi, SENTINEL)
     klo = jnp.where(valid, klo, SENTINEL)
-    sh, sl, order = jax.lax.sort((khi, klo, jnp.arange(k, dtype=jnp.int32)),
+    import jax
+    sh, sl, order = jax.lax.sort((khi, klo, jnp.arange(k, dtype=_I32)),
                                  num_keys=2)
     is_sent = (sh == SENTINEL) & (sl == SENTINEL)
     prev_ne = jnp.concatenate([
@@ -77,21 +124,114 @@ def dedup_batch(khi, klo, valid):
     return (sh, sl), order, prev_ne & ~is_sent
 
 
-def merge(s: FPSet, new_hi, new_lo, new_valid) -> FPSet:
-    """Insert a batch of (assumed not-already-present) keys; keeps the array
-    sorted.  Invalid lanes are sentinels and fall off the concat+sort+slice
-    iff size + #valid <= capacity (engine checks ``size`` after)."""
+def insert_unique(s: FPSet, qhi, qlo, valid) -> Tuple["FPSet", jnp.ndarray,
+                                                      jnp.ndarray]:
+    """Insert a batch of keys.  Returns ``(table', is_new, fail)``:
+    ``is_new[k]`` marks exactly one query per distinct key not previously in
+    the table; ``fail`` is True if any valid query exhausted its probes.
+
+    PRECONDITION: valid keys are pairwise distinct (use ``dedup_batch``
+    first).  The claim round still resolves the rare *hash* collision of
+    distinct keys on one slot deterministically, but heavy same-key batches
+    would serialize the claim scatter — that case is the pre-pass's job."""
     c = s.hi.shape[0]
-    nh = jnp.where(new_valid, new_hi, SENTINEL)
-    nl = jnp.where(new_valid, new_lo, SENTINEL)
-    ch = jnp.concatenate([s.hi, nh])
-    cl = jnp.concatenate([s.lo, nl])
-    sh, sl = jax.lax.sort((ch, cl), num_keys=2)
-    return FPSet(hi=sh[:c], lo=sl[:c],
-                 size=s.size + jnp.sum(new_valid, dtype=jnp.int32))
+    (qhi, qlo, valid), k = _pad_pow2(
+        (qhi, qlo, jnp.asarray(valid, bool)),
+        (SENTINEL, SENTINEL, False))
+    kp = qhi.shape[0]
+    hi, lo = s.hi, s.lo
+    h1, h2 = _probe_base(qhi, qlo, c)
+    arange = jnp.arange(kp, dtype=_I32)
+    spread = (arange & (c - 1)).astype(_I32)   # cold per-lane addresses
+    pending = valid
+    is_new = jnp.zeros((kp,), bool)
+    claim = jnp.full((c,), -1, _I32)
+    for r in range(PROBE_ROUNDS):
+        probe = ((h1 + _U32(r) * h2) & _U32(c - 1)).astype(_I32)
+        idx = jnp.where(pending, probe, spread)
+        cur_hi, cur_lo = hi[idx], lo[idx]
+        match = pending & (cur_hi == qhi) & (cur_lo == qlo)
+        pending = pending & ~match
+        attempt = pending & (cur_hi == SENTINEL) & (cur_lo == SENTINEL)
+        a_idx = jnp.where(attempt, idx, c)
+        claim = claim.at[a_idx].max(arange, mode="drop")
+        win = attempt & (claim[idx] == arange)
+        w_idx = jnp.where(win, idx, c)
+        hi = hi.at[w_idx].set(qhi, mode="drop")
+        lo = lo.at[w_idx].set(qlo, mode="drop")
+        is_new = is_new | win
+        pending = pending & ~win
+        claim = claim.at[a_idx].set(-1, mode="drop")   # reset touched slots
+    return (FPSet(hi=hi, lo=lo,
+                  size=s.size + jnp.sum(is_new, dtype=_I32)),
+            is_new[:k], jnp.any(pending))
+
+
+def insert(s: FPSet, qhi, qlo, valid) -> Tuple["FPSet", jnp.ndarray,
+                                               jnp.ndarray]:
+    """Full-batch insert: dedup pre-pass + unique insert.  Returns
+    ``(table', is_new, fail)`` with ``is_new`` in the *caller's* (unsorted)
+    index domain — exactly one index per distinct new key is marked.
+    Pads to a power of two up front so the sort and every probe run on
+    fast shapes."""
+    (qhi, qlo, valid), k = _pad_pow2(
+        (qhi, qlo, jnp.asarray(valid, bool)),
+        (SENTINEL, SENTINEL, False))
+    kp = qhi.shape[0]
+    (sh, sl), order, first = dedup_batch(qhi, qlo, valid)
+    s, new_sorted, fail = insert_unique(s, sh, sl, first)
+    is_new = jnp.zeros((kp,), bool).at[order].set(new_sorted)
+    return s, is_new[:k], fail
+
+
+def contains(s: FPSet, qhi, qlo):
+    """Membership for a batch of keys.  [K] bool.  Sentinel-keyed (invalid)
+    lanes report False."""
+    c = s.hi.shape[0]
+    (qhi, qlo), k = _pad_pow2((qhi, qlo), (SENTINEL, SENTINEL))
+    kp = qhi.shape[0]
+    h1, h2 = _probe_base(qhi, qlo, c)
+    live = ~((qhi == SENTINEL) & (qlo == SENTINEL))
+    spread = (jnp.arange(kp, dtype=_I32) & (c - 1)).astype(_I32)
+    found = jnp.zeros(qhi.shape, bool)
+    open_ = live                          # probe chain still unbroken
+    for r in range(PROBE_ROUNDS):
+        probe = ((h1 + _U32(r) * h2) & _U32(c - 1)).astype(_I32)
+        idx = jnp.where(open_, probe, spread)
+        cur_hi, cur_lo = s.hi[idx], s.lo[idx]
+        found = found | (open_ & (cur_hi == qhi) & (cur_lo == qlo))
+        open_ = open_ & ~((cur_hi == SENTINEL) & (cur_lo == SENTINEL))
+    return found[:k]
 
 
 def to_host_keys(s: FPSet) -> Tuple[np.ndarray, np.ndarray]:
-    """Materialize the real keys host-side (checkpointing)."""
-    n = int(s.size)
-    return np.asarray(s.hi[:n]), np.asarray(s.lo[:n])
+    """Materialize the stored keys host-side, lex-sorted (hi, lo) for a
+    deterministic checkpoint layout."""
+    hi = np.asarray(s.hi)
+    lo = np.asarray(s.lo)
+    real = ~((hi == SENTINEL) & (lo == SENTINEL))
+    hi, lo = hi[real], lo[real]
+    order = np.lexsort((lo, hi))
+    return hi[order], lo[order]
+
+
+def from_host_keys(keys_hi: np.ndarray, keys_lo: np.ndarray,
+                   capacity: int, chunk: int = 1 << 15) -> FPSet:
+    """Rebuild a table from checkpointed keys (keys are distinct)."""
+    import jax
+
+    s = empty(capacity)
+    ins = jax.jit(insert, donate_argnums=(0,))
+    n = len(keys_hi)
+    for base in range(0, n, chunk):
+        h = np.asarray(keys_hi[base:base + chunk], np.uint32)
+        l = np.asarray(keys_lo[base:base + chunk], np.uint32)
+        pad = chunk - len(h)
+        valid = np.arange(chunk) < len(h)
+        s, _new, fail = ins(
+            s, jnp.asarray(np.pad(h, (0, pad))),
+            jnp.asarray(np.pad(l, (0, pad))), jnp.asarray(valid))
+        if bool(fail):
+            raise RuntimeError(
+                f"FPSet rebuild overflow: {n} keys into capacity {capacity}")
+    return s

@@ -10,7 +10,7 @@ the program is identical):
 - each chip expands its local batch and fingerprints its candidates;
 - **fingerprint-owner dedup**: candidate fps are routed to their owner chip
   (``fp_hi mod n``) with one ``all_to_all``; the owner runs the same
-  sort-dedup + sorted-set probe/merge as the single-chip engine on the
+  batched hash-table insert (ops/fpset.py) as the single-chip engine on the
   union of arriving queries, then a reverse ``all_to_all`` returns one
   novelty bit per query.  Exactly one copy of each globally-new state gets
   the bit, so states enqueue on the chip that *generated* them — only
@@ -75,7 +75,8 @@ class MeshBFSEngine:
         # Per-chip capacities.
         per_chip = -(-cfg.queue_capacity // n)
         QL = max(B, -(-per_chip // B) * B)   # round up to a batch multiple
-        CL = -(-cfg.seen_capacity // n)
+        # Per-chip hash-table shard: power of two for masked probing.
+        CL = fpset._capacity(-(-cfg.seen_capacity // n))
         self._sw, self._B, self._QL, self._CL = sw, B, QL, CL
 
         def local_absorb(crows, cands, en, parent_hi, parent_lo, actions,
@@ -99,15 +100,15 @@ class MeshBFSEngine:
             bh = jax.lax.all_to_all(bh, "x", 0, 0, tiled=True)
             bl = jax.lax.all_to_all(bl, "x", 0, 0, tiled=True)
 
-            # Owner side: dedup the union of arriving queries, probe, merge.
+            # Owner side: one hash-table insert over the union of arriving
+            # queries — in-batch dedup and seen-set probe/update in one
+            # pass; exactly one arriving copy of each globally-new key gets
+            # the novelty bit.
             rh, rl = bh.reshape(-1), bl.reshape(-1)
             rvalid = ~((rh == SENTINEL) & (rl == SENTINEL))
-            (qsh, qsl), qorder, qfirst = fpset.dedup_batch(rh, rl, rvalid)
             seen_local = fpset.FPSet(hi=shi, lo=slo, size=ssize)
-            qnew = qfirst & ~fpset.contains(seen_local, qsh, qsl)
-            seen_local = fpset.merge(seen_local, qsh, qsl, qnew)
-            nov = jnp.zeros((n * k,), bool).at[qorder].set(qnew)
-            nov = jax.lax.all_to_all(nov.reshape(n, k), "x", 0, 0,
+            seen_local, qnew, fail = fpset.insert(seen_local, rh, rl, rvalid)
+            nov = jax.lax.all_to_all(qnew.reshape(n, k), "x", 0, 0,
                                      tiled=True)
             # Back on the origin chip: one novelty bit per local candidate.
             new_sortpos = nov[osort, rank]
@@ -142,7 +143,7 @@ class MeshBFSEngine:
                   compact(parent_lo), compact(actions))
             vinfo = (viol_any, inv[vpos], crows[vpos], fph[vpos], fpl[vpos])
             return (qnext, next_count, seen_local.hi, seen_local.lo,
-                    seen_local.size, n_new, tr, vinfo)
+                    seen_local.size, n_new, fail, tr, vinfo)
 
         def sharded_step(qcur, cur_count, offset, qnext, next_count,
                          shi, slo, ssize):
@@ -165,7 +166,7 @@ class MeshBFSEngine:
             crows = jax.vmap(flatten_state, (0, None))(cflat, dims)
             php, plp = jax.vmap(fingerprint)(states)
             k_idx = jnp.arange(K, dtype=_I32)
-            (qnext_l, ncnt_l, shi_l, slo_l, ssz_l, n_new, tr,
+            (qnext_l, ncnt_l, shi_l, slo_l, ssz_l, n_new, fail, tr,
              vinfo) = local_absorb(
                 crows, cflat, en.reshape(-1), php[k_idx // G],
                 plp[k_idx // G], k_idx % G, qnext_l, ncnt_l,
@@ -173,7 +174,9 @@ class MeshBFSEngine:
             g_new = jax.lax.psum(n_new, "x")
             g_gen = jax.lax.psum(jnp.sum(en, dtype=_I32), "x")
             g_ovf = jax.lax.psum(jnp.sum(ovf, dtype=_I32), "x")
-            stats = (g_new[None], g_gen[None], g_ovf[None], dead_any[None])
+            g_fail = jax.lax.psum(fail.astype(_I32), "x")
+            stats = (g_new[None], g_gen[None], g_ovf[None], dead_any[None],
+                     g_fail[None])
             return (qnext_l[None], ncnt_l[None], shi_l[None], slo_l[None],
                     ssz_l[None], stats,
                     tuple(x[None] for x in tr),
@@ -185,13 +188,14 @@ class MeshBFSEngine:
             states = jax.vmap(unflatten_state, (0, None))(rows_l, dims)
             sent = jnp.zeros(rows_l.shape[:1], _U32)
             acts = jnp.full(rows_l.shape[:1], -1, _I32)
-            (qnext_l, ncnt_l, shi_l, slo_l, ssz_l, n_new, tr,
+            (qnext_l, ncnt_l, shi_l, slo_l, ssz_l, n_new, fail, tr,
              vinfo) = local_absorb(
                 rows_l, states, valid_l, sent, sent, acts,
                 qnext[0], next_count[0], shi[0], slo[0], ssize[0])
             g_new = jax.lax.psum(n_new, "x")
+            g_fail = jax.lax.psum(fail.astype(_I32), "x")
             return (qnext_l[None], ncnt_l[None], shi_l[None], slo_l[None],
-                    ssz_l[None], g_new[None],
+                    ssz_l[None], g_new[None], g_fail[None],
                     tuple(x[None] for x in tr),
                     tuple(jnp.asarray(x)[None] for x in vinfo),
                     n_new[None])
@@ -203,12 +207,13 @@ class MeshBFSEngine:
             sharded_step,
             in_specs=(sx, sx, rep, sx, sx, sx, sx, sx),
             out_specs=(sx, sx, sx, sx, sx,
-                       (sx, sx, sx, sx), (sx,) * 5, (sx,) * 5, sx, sx)),
+                       (sx, sx, sx, sx, sx), (sx,) * 5, (sx,) * 5, sx, sx)),
             donate_argnums=(3, 5, 6))
         self._ingest = jax.jit(shard(
             sharded_ingest,
             in_specs=(sx, sx, sx, sx, sx, sx, sx),
-            out_specs=(sx, sx, sx, sx, sx, sx, (sx,) * 5, (sx,) * 5, sx)),
+            out_specs=(sx, sx, sx, sx, sx, sx, sx,
+                       (sx,) * 5, (sx,) * 5, sx)),
             donate_argnums=(2, 4, 5))
 
         def fp_rows(rows):
@@ -265,11 +270,12 @@ class MeshBFSEngine:
                 valid[d, :len(part)] = True
             out = self._ingest(jnp.asarray(wave), jnp.asarray(valid),
                                qnext, next_counts, shi, slo, ssize)
-            (qnext, next_counts, shi, slo, ssize, g_new, tr, vinfo,
+            (qnext, next_counts, shi, slo, ssize, g_new, g_fail, tr, vinfo,
              l_new) = out
             res.distinct += int(np.asarray(g_new)[0])
             self._record(trace, tr, np.asarray(l_new))
-            self._capacity_check(next_counts, ssize)
+            self._capacity_check(next_counts, ssize,
+                                 int(np.asarray(g_fail)[0]))
             if self._check_violation(res, vinfo):
                 break
 
@@ -304,7 +310,8 @@ class MeshBFSEngine:
                 res.distinct += g_new
                 res.generated += g_gen
                 self._record(trace, tr, np.asarray(l_new))
-                self._capacity_check(next_counts, ssize)
+                self._capacity_check(next_counts, ssize,
+                                     int(np.asarray(stats[4])[0]))
                 if self._check_violation(res, vinfo):
                     break
                 if dead.any() and cfg.check_deadlock:
@@ -330,10 +337,10 @@ class MeshBFSEngine:
         return res
 
     # ------------------------------------------------------------------
-    def _capacity_check(self, next_counts, ssize):
+    def _capacity_check(self, next_counts, ssize, fail=0):
         if int(np.asarray(next_counts).max()) > self._QL:
             raise RuntimeError("per-chip queue capacity exceeded")
-        if int(np.asarray(ssize).max()) > self._CL:
+        if fail or int(np.asarray(ssize).max()) > self._CL:
             raise RuntimeError("per-chip seen-set capacity exceeded")
 
     def _record(self, trace, tr, l_new):

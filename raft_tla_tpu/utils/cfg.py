@@ -303,9 +303,24 @@ def load_config(cfg_path: str, max_log: Optional[int] = None,
     if "StopAfter" in cfg.constraints:
         max_seconds, max_diameter = stop_dur, stop_dia
 
+    # TargetConfigs (a set of membership bitmasks over the interned server
+    # order) selects the joint-consensus reconfiguration variant
+    # (models/reconfig.py) — the BASELINE.json configs[4] state space.
+    if "TargetConfigs" in cfg.assignments:
+        from ..models.reconfig import ReconfigDims
+        raw = cfg.assignments["TargetConfigs"]
+        if not isinstance(raw, tuple):
+            raw = (raw,)
+        targets = tuple(sorted(int(x) for x in raw))
+        dims = ReconfigDims(n_servers=len(servers), n_values=len(values),
+                            max_log=max_log, n_msg_slots=n_msg_slots,
+                            targets=targets)
+    else:
+        dims = RaftDims(n_servers=len(servers), n_values=len(values),
+                        max_log=max_log, n_msg_slots=n_msg_slots)
+
     return CheckSetup(
-        dims=RaftDims(n_servers=len(servers), n_values=len(values),
-                      max_log=max_log, n_msg_slots=n_msg_slots),
+        dims=dims,
         bounds=bounds,
         invariants=list(cfg.invariants),
         constraints=[c for c in cfg.constraints if c != "StopAfter"],

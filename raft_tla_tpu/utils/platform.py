@@ -34,3 +34,20 @@ def neutralize_axon_if_cpu_requested() -> None:
     leaves real-TPU runs (JAX_PLATFORMS=axon) untouched."""
     if "cpu" in os.environ.get("JAX_PLATFORMS", ""):
         force_cpu()
+
+
+def enable_persistent_cache() -> None:
+    """Point jax at the repo-local persistent compilation cache.  The BFS
+    chunk program takes ~1 min (TPU) to minutes (CPU) to compile; with the
+    cache, every CLI/bench/driver invocation after the first is instant.
+    Safe to call multiple times, before or after backend init."""
+    import jax
+
+    cache = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__)))), ".jax_cache")
+    try:
+        jax.config.update("jax_compilation_cache_dir", cache)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+    except Exception:
+        pass

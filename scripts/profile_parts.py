@@ -1,0 +1,97 @@
+"""Bisect the BFS step cost: time expand / flatten / fingerprint / insert /
+enqueue in isolation on the ambient platform."""
+
+import sys
+import time
+
+sys.path.insert(0, ".")
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from raft_tla_tpu.models.actions import build_expand
+from raft_tla_tpu.models.invariants import build_type_ok, build_inv_id
+from raft_tla_tpu.models.pystate import init_state
+from raft_tla_tpu.models.schema import (encode_state, flatten_state,
+                                        state_width, unflatten_state)
+from raft_tla_tpu.ops import fpset
+from raft_tla_tpu.ops.fingerprint import build_fingerprint
+from raft_tla_tpu.utils.cfg import load_config
+
+
+def timeit(name, fn, *args, n=5):
+    jax.block_until_ready(fn(*args))
+    t0 = time.time()
+    for _ in range(n):
+        out = fn(*args)
+    jax.block_until_ready(out)
+    print(f"{name:44s} {(time.time() - t0) / n * 1e3:9.2f} ms")
+
+
+def main():
+    print("platform:", jax.devices()[0].platform)
+    setup = load_config("configs/MCraft_bounded.cfg")
+    dims = setup.dims
+    B, G, SW = 2048, dims.n_instances, state_width(dims)
+    print(f"B={B} G={G} SW={SW}  B*G={B*G}")
+    expand = build_expand(dims)
+    fingerprint = build_fingerprint(dims)
+
+    row = flatten_state(encode_state(init_state(dims), dims), dims)
+    rows = jnp.asarray(np.tile(row[None, :], (B, 1)).astype(np.int32))
+
+    @jax.jit
+    def just_expand(rows):
+        states = jax.vmap(unflatten_state, (0, None))(rows, dims)
+        cands, en, ovf = jax.vmap(expand)(states)
+        return jax.tree.map(lambda a: jnp.sum(a), cands), en.sum(), ovf.sum()
+
+    @jax.jit
+    def expand_flatten(rows):
+        states = jax.vmap(unflatten_state, (0, None))(rows, dims)
+        cands, en, ovf = jax.vmap(expand)(states)
+        cflat = jax.tree.map(lambda a: a.reshape((B * G,) + a.shape[2:]),
+                             cands)
+        crows = jax.vmap(flatten_state, (0, None))(cflat, dims)
+        return crows, en, ovf
+
+    @jax.jit
+    def fp_of_rows(crows):
+        states = jax.vmap(unflatten_state, (0, None))(crows, dims)
+        return jax.vmap(fingerprint)(states)
+
+    inv = build_type_ok(dims)
+
+    @jax.jit
+    def inv_of_rows(crows):
+        states = jax.vmap(unflatten_state, (0, None))(crows, dims)
+        return jax.vmap(build_inv_id([inv]))(states)
+
+    timeit("expand only (reduced)", just_expand, rows)
+    timeit("expand + flatten -> crows", expand_flatten, rows)
+    crows, en, _ = expand_flatten(rows)
+    crows = jax.block_until_ready(crows)
+    timeit("fingerprint 270k rows", fp_of_rows, crows)
+    timeit("TypeOK 270k rows", inv_of_rows, crows)
+
+    fph, fpl = fp_of_rows(crows)
+    seen = fpset.empty(1 << 23)
+    timeit("hash insert 270k", jax.jit(fpset.insert), seen, fph, fpl,
+           en.reshape(-1))
+
+    Q = 1 << 20
+    qnext = jnp.zeros((Q, SW), jnp.int32)
+
+    @jax.jit
+    def enqueue(qnext, crows, enq):
+        pos = jnp.cumsum(enq.astype(jnp.int32)) - 1
+        pos = jnp.where(enq, pos, Q)
+        return qnext.at[pos].set(crows, mode="drop")
+
+    timeit("enqueue scatter 270k rows", enqueue, qnext, crows,
+           en.reshape(-1))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,82 @@
+"""Time one real BFS engine step on the ambient platform, separating
+device compute from host round-trips — to find where the states/sec go."""
+
+import sys
+import time
+
+sys.path.insert(0, ".")
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from raft_tla_tpu.engine.bfs import EngineConfig
+from raft_tla_tpu.engine.check import make_engine
+from raft_tla_tpu.models.pystate import init_state
+from raft_tla_tpu.models.schema import encode_state, flatten_state
+from raft_tla_tpu.utils.cfg import load_config
+from raft_tla_tpu.ops import fpset
+
+
+def main():
+    print("platform:", jax.devices()[0].platform)
+    setup = load_config("configs/MCraft_bounded.cfg")
+    cfg = EngineConfig(batch=2048, queue_capacity=1 << 20,
+                       seen_capacity=1 << 23, record_trace=False)
+    eng = make_engine(setup, cfg)
+    dims = setup.dims
+    print("dims:", dims, "G:", dims.n_instances, "SW:", eng._sw)
+
+    row = flatten_state(encode_state(init_state(dims), dims), dims)
+    Q = eng._Q
+    qcur = jnp.asarray(np.tile(row[None, :], (Q, 1)).astype(np.int32))
+    B = cfg.batch
+
+    def fresh():
+        return (jnp.zeros((Q, eng._sw), jnp.int32),
+                fpset.empty(cfg.seen_capacity))
+
+    # Warm-up/compile.
+    qnext, seen = fresh()
+    out = eng._step(qcur, jnp.int32(B), jnp.int32(0), qnext, jnp.int32(0),
+                    seen)
+    jax.block_until_ready(out)
+
+    # Pure device time: run 10 steps, sync once at the end.
+    n = 10
+    qnext, seen = fresh()
+    nc = jnp.int32(0)
+    t0 = time.time()
+    for _ in range(n):
+        out = eng._step(qcur, jnp.int32(B), jnp.int32(0), qnext, nc, seen)
+        qnext, nc, seen = out[0], out[1], out[2]
+    jax.block_until_ready(out)
+    dev_ms = (time.time() - t0) / n * 1e3
+    print(f"device-only step                    {dev_ms:9.2f} ms")
+
+    # Step + the host scalar fetches the run loop does.
+    qnext, seen = fresh()
+    nc = jnp.int32(0)
+    t0 = time.time()
+    for _ in range(n):
+        out = eng._step(qcur, jnp.int32(B), jnp.int32(0), qnext, nc, seen)
+        qnext, nc, seen, stats = out[0], out[1], out[2], out[3]
+        _ = (int(stats[0]), int(stats[1]), int(stats[2]), bool(stats[3]),
+             bool(stats[4]))
+        _ = int(seen.size)
+        _ = int(nc)
+        _ = bool(out[5][0])
+    sync_ms = (time.time() - t0) / n * 1e3
+    print(f"step + host scalar fetches          {sync_ms:9.2f} ms")
+
+    # One scalar round-trip (tunnel RTT floor).
+    x = jnp.int32(7)
+    t0 = time.time()
+    for _ in range(n):
+        _ = int(x + 1)
+    print(f"single scalar device->host fetch    "
+          f"{(time.time() - t0) / n * 1e3:9.2f} ms")
+
+
+if __name__ == "__main__":
+    main()

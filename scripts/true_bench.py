@@ -1,0 +1,161 @@
+"""Trustworthy device timings under the axon tunnel.
+
+``block_until_ready`` does not reliably block on this backend, so every
+measurement here loops the op N times inside ONE jitted ``lax.fori_loop``
+(data-chained so iterations can't collapse) and ends with a host fetch of a
+scalar — a true barrier.  Reported per-iteration time subtracts nothing;
+with N=8 the dispatch+RTT overhead is amortized to noise.
+"""
+
+import sys
+import time
+
+sys.path.insert(0, ".")
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from raft_tla_tpu.models.actions import build_expand
+from raft_tla_tpu.models.schema import flatten_state, unflatten_state
+from raft_tla_tpu.ops import fpset
+from raft_tla_tpu.ops.fingerprint import SENTINEL, build_fingerprint
+from raft_tla_tpu.utils.cfg import load_config
+
+N = 4
+
+
+def timed(name, jitted, *args):
+    out = jitted(*args)
+    _ = float(np.asarray(jax.tree.leaves(out)[0]).ravel()[0])  # barrier
+    t0 = time.time()
+    out = jitted(*args)
+    _ = float(np.asarray(jax.tree.leaves(out)[0]).ravel()[0])  # barrier
+    dt = (time.time() - t0) / N * 1e3
+    print(f"{name:46s} {dt:9.2f} ms/iter")
+    return dt
+
+
+def main():
+    print("platform:", jax.devices()[0].platform, " N =", N)
+    setup = load_config("configs/MCraft_bounded.cfg")
+    dims = setup.dims
+    B, G = 2048, dims.n_instances
+    K = B * G
+    ck = np.load("/tmp/ck/level_00008.npz", allow_pickle=True)
+    rows = jnp.asarray(ck["frontier"][:B].astype(np.int32))
+    d = np.load("/tmp/realkeys.npz")
+    fph = jnp.asarray(d["fph"])
+    fpl = jnp.asarray(d["fpl"])
+    enf = jnp.asarray(d["enf"])
+    expand = build_expand(dims)
+    fingerprint = build_fingerprint(dims)
+    C = 1 << 23
+
+    @jax.jit
+    def loop_insert(fph, fpl, enf):
+        s = fpset.empty(C)
+
+        def body(i, carry):
+            s, acc = carry
+            s2, new, fail = fpset.insert(s, fph ^ i.astype(jnp.uint32),
+                                         fpl, enf)
+            return s2, acc + jnp.sum(new, dtype=jnp.int32)
+
+        s, acc = jax.lax.fori_loop(0, N, body, (s, jnp.int32(0)))
+        return acc
+
+    timed("insert 270k real keys", loop_insert, fph, fpl, enf)
+
+    @jax.jit
+    def loop_dedup(fph, fpl, enf):
+        def body(i, acc):
+            (sh, sl), order, first = fpset.dedup_batch(
+                fph ^ i.astype(jnp.uint32), fpl, enf)
+            return acc + jnp.sum(first, dtype=jnp.int32)
+
+        return jax.lax.fori_loop(0, N, body, jnp.int32(0))
+
+    timed("dedup_batch (sort 270k)", loop_dedup, fph, fpl, enf)
+
+    @jax.jit
+    def loop_bigsort(fph):
+        base = jnp.full((C,), SENTINEL, jnp.uint32)
+
+        def body(i, acc):
+            ch = jnp.concatenate([base, fph ^ i.astype(jnp.uint32)])
+            sh, _sl = jax.lax.sort((ch, ch), num_keys=2)
+            return acc + sh[0].astype(jnp.int32)
+
+        return jax.lax.fori_loop(0, N, body, jnp.int32(0))
+
+    timed("merge-sort 8M+270k (old FPSet)", loop_bigsort, fph)
+
+    @jax.jit
+    def loop_expand(rows):
+        def body(i, acc):
+            states = jax.vmap(unflatten_state, (0, None))(
+                rows.at[0, 0].add(i), dims)
+            cands, en, ovf = jax.vmap(expand)(states)
+            cflat = jax.tree.map(
+                lambda a: a.reshape((K,) + a.shape[2:]), cands)
+            crows = jax.vmap(flatten_state, (0, None))(cflat, dims)
+            return acc + jnp.sum(crows[:, 0], dtype=jnp.int32) \
+                + jnp.sum(en, dtype=jnp.int32)
+
+        return jax.lax.fori_loop(0, N, body, jnp.int32(0))
+
+    timed("expand+flatten 2048 states", loop_expand, rows)
+
+    @jax.jit
+    def loop_fp(rows):
+        def body(i, acc):
+            states = jax.vmap(unflatten_state, (0, None))(
+                rows.at[0, 0].add(i), dims)
+            cands, en, ovf = jax.vmap(expand)(states)
+            cflat = jax.tree.map(
+                lambda a: a.reshape((K,) + a.shape[2:]), cands)
+            crows = jax.vmap(flatten_state, (0, None))(cflat, dims)
+            st2 = jax.vmap(unflatten_state, (0, None))(crows, dims)
+            fh, fl = jax.vmap(fingerprint)(st2)
+            return acc + jnp.sum(fh, dtype=jnp.uint32).astype(jnp.int32)
+
+        return jax.lax.fori_loop(0, N, body, jnp.int32(0))
+
+    t_fp = timed("expand+flatten+fingerprint", loop_fp, rows)
+
+    Q = 1 << 20
+    crows = jnp.zeros((K, 473), jnp.int32)
+
+    @jax.jit
+    def loop_enqueue(crows, enf):
+        qnext = jnp.zeros((Q, 473), jnp.int32)
+
+        def body(i, carry):
+            qnext, acc = carry
+            enq = enf
+            pos = jnp.cumsum(enq.astype(jnp.int32)) - 1
+            pos = jnp.where(enq, pos + i, Q)
+            qnext = qnext.at[pos].set(crows, mode="drop")
+            return qnext, acc + qnext[0, 0]
+
+        qnext, acc = jax.lax.fori_loop(0, N, body, (qnext, jnp.int32(0)))
+        return acc
+
+    timed("enqueue row-scatter 270k->1M", loop_enqueue, crows, enf)
+
+    @jax.jit
+    def loop_gather_rows(crows, enf):
+        order = jnp.argsort(~enf)           # enabled rows first
+
+        def body(i, acc):
+            sel = crows[order + i - i]      # row gather 270k x 473
+            return acc + sel[0, 0]
+
+        return jax.lax.fori_loop(0, N, body, jnp.int32(0))
+
+    timed("row-gather 270k x 473", loop_gather_rows, crows, enf)
+
+
+if __name__ == "__main__":
+    main()
