@@ -12,7 +12,7 @@ to one XLA program per step:
       -> scatter new+constraint-passing states into the next-level queue
       -> invariant ids, deadlock mask, violation/overflow reporting
 
-Everything device-resident: the two level queues (flat int32 state rows),
+Everything device-resident: the two level queues (flat uint8 state rows),
 the FPSet, and all masks.  The host loop only advances offsets, swaps queues
 between levels, reads back a handful of scalars per batch, and appends
 (fingerprint -> parent fingerprint, action id) records to the trace store —
@@ -49,7 +49,8 @@ from ..models.dims import RaftDims
 from ..models.actions import build_expand
 from ..models.invariants import build_inv_id
 from ..models.pystate import PyState
-from ..models.schema import (StateBatch, decode_state, encode_state,
+from ..models.schema import (ROW_DTYPE, StateBatch, build_pack_guard,
+                             check_packable, decode_state, encode_state,
                              flatten_state, state_width, unflatten_state)
 from ..ops import fpset
 from ..ops.fingerprint import build_fingerprint
@@ -104,6 +105,42 @@ from .trace import PyTraceStore as TraceStore  # noqa: E402
 from .trace import make_trace_store  # noqa: E402
 
 
+def build_root_check(inv_fns, fingerprint):
+    """jit'd ``StateBatch batch -> (inv ids, fp_hi, fp_lo)``.
+
+    Root states are invariant-checked on their *unpacked* int32 encoding:
+    the uint8 row packing wraps out-of-range values (a hand-crafted or
+    randomized root with matchIndex = -1 becomes 255, a legal Nat), so a
+    post-packing TypeOK check would miss them.  TLC checks invariants on
+    initial states before exploration; the engines do the same, on the
+    exact values given.  Kernel-produced successors are in-range by
+    construction and need no such pass."""
+    def check(batch):
+        inv = jax.vmap(build_inv_id(inv_fns))(batch)
+        fph, fpl = jax.vmap(fingerprint)(batch)
+        return inv, fph, fpl
+    return jax.jit(check)
+
+
+def find_root_violation(root_check, encoded, init_states, batch_size,
+                        inv_names) -> Optional[Violation]:
+    """Run ``build_root_check``'s program over the encoded roots in
+    fixed-size chunks (padding by repeating the last root so one program
+    shape serves any root count); first violation wins, like TLC."""
+    from ..models.schema import stack_states
+    for base in range(0, len(encoded), batch_size):
+        chunk = encoded[base:base + batch_size]
+        pad = [chunk[-1]] * (batch_size - len(chunk))
+        inv, fph, fpl = root_check(stack_states(chunk + pad))
+        inv = np.asarray(inv)[:len(chunk)]
+        if (inv >= 0).any():
+            i = int(np.argmax(inv >= 0))
+            fp = (int(np.asarray(fph)[i]) << 32) | int(np.asarray(fpl)[i])
+            return Violation(invariant=inv_names[int(inv[i])],
+                             state=init_states[base + i], fingerprint=fp)
+    return None
+
+
 class BFSEngine:
     """Exhaustive checker for one compiled (dims, invariants, constraint)."""
 
@@ -118,6 +155,7 @@ class BFSEngine:
         inv_fns = list((invariants or {}).values())
         expand = build_expand(dims)
         fingerprint = build_fingerprint(dims)
+        pack_ok = build_pack_guard(dims)
         sw = state_width(dims)
         B, G = cfg.batch, dims.n_instances
         # Queue offsets advance in whole batches; capacity must be a
@@ -200,7 +238,10 @@ class BFSEngine:
             states = jax.vmap(unflatten_state, (0, None))(rows, dims)
             cands, en, ovf = jax.vmap(expand)(states)
             en = en & valid[:, None]
-            ovf = ovf & valid[:, None]
+            # A successor whose term/bag count outgrew the uint8 row is an
+            # overflow too (schema.build_pack_guard): stop, never alias.
+            ovf = (ovf | (en & ~jax.vmap(jax.vmap(pack_ok))(cands))) \
+                & valid[:, None]
             dead_b = valid & ~jnp.any(en, axis=1) & ~jnp.any(ovf, axis=1)
             dead_any_b = jnp.any(dead_b)
             drow_b = rows[jnp.argmax(dead_b)]
@@ -263,8 +304,9 @@ class BFSEngine:
                   tbuf, tcount0):
             init = (offset0, jnp.int32(0), qnext, next_count, seen, tbuf,
                     tcount0, jnp.int32(0), jnp.int32(0), jnp.int32(0),
-                    jnp.bool_(False), jnp.zeros((sw,), _I32),
-                    jnp.bool_(False), jnp.int32(-1), jnp.zeros((sw,), _I32),
+                    jnp.bool_(False), jnp.zeros((sw,), jnp.uint8),
+                    jnp.bool_(False), jnp.int32(-1),
+                    jnp.zeros((sw,), jnp.uint8),
                     jnp.uint32(0), jnp.uint32(0), jnp.bool_(False))
 
             def cond(c):
@@ -299,6 +341,8 @@ class BFSEngine:
         self._fp_rows = jax.jit(fp_rows)
         self._expand1 = jax.jit(expand)
         self._fp_batch = jax.jit(jax.vmap(fingerprint))
+        self._root_check = (build_root_check(inv_fns, fingerprint)
+                            if inv_fns else None)
         self._TQ = TQ
 
     # ------------------------------------------------------------------
@@ -325,8 +369,27 @@ class BFSEngine:
         trace = make_trace_store() if cfg.record_trace else TraceStore()
         self.trace = trace
 
-        qcur = jnp.zeros((Q, sw), _I32)
-        qnext = jnp.zeros((Q, sw), _I32)
+        if resume is None:
+            # Root handling before warm-up: neither the root check's XLA
+            # compile nor a violating root charges the duration budget (TLC
+            # reports an init-state violation without starting the clock).
+            encoded = [encode_state(s, dims) for s in init_states]
+            if self._root_check is not None:
+                v = find_root_violation(self._root_check, encoded,
+                                        init_states, B, self.inv_names)
+                if v is not None:
+                    res.violation = v
+                    res.stop_reason = "violation"
+                    res.levels.append(0)
+                    return res
+            # Only now reject unpackable roots (see schema.check_packable:
+            # an invariant-flagged root is a violation, not an error).
+            for e in encoded:
+                check_packable(e)
+            rows_np = np.stack([flatten_state(e, dims) for e in encoded])
+
+        qcur = jnp.zeros((Q, sw), jnp.uint8)
+        qnext = jnp.zeros((Q, sw), jnp.uint8)
         seen = fpset.empty(cfg.seen_capacity)
         next_count = jnp.int32(0)
         TQ = self._TQ
@@ -338,7 +401,8 @@ class BFSEngine:
         # effect: all-invalid masks insert nothing, zero-trip chunk) so XLA
         # compilation does not count against the StopAfter duration budget —
         # TLC's TLCGet("duration") measures checking, not compilation.
-        out = self._ingest(jnp.zeros((B, sw), _I32), jnp.zeros((B,), bool),
+        out = self._ingest(jnp.zeros((B, sw), jnp.uint8),
+                           jnp.zeros((B,), bool),
                            qnext, next_count, seen)
         qnext, next_count, seen = out[0], out[1], out[2]
         out = self._chunk(qcur, jnp.int32(0), jnp.int32(0),
@@ -357,11 +421,13 @@ class BFSEngine:
                     f"{cfg.seen_capacity}")
             seen = fpset.from_host_keys(resume.seen_hi, resume.seen_lo,
                                         cfg.seen_capacity)
-            fr = np.ascontiguousarray(resume.frontier, np.int32)
+            fr = np.ascontiguousarray(resume.frontier).astype(
+                ROW_DTYPE, casting="safe")
             if len(fr) > Q:
                 raise RuntimeError(
                     f"checkpoint frontier {len(fr)} > queue capacity {Q}")
-            qcur = jnp.zeros((Q, sw), _I32).at[:len(fr)].set(jnp.asarray(fr))
+            qcur = jnp.zeros((Q, sw), jnp.uint8).at[:len(fr)].set(
+                jnp.asarray(fr))
             cur_count = len(fr)
             res.distinct = resume.distinct
             res.generated = resume.generated
@@ -390,9 +456,6 @@ class BFSEngine:
                     "checkpoint_dir or keep tracing enabled")
         else:
             # Ingest initial states in B-sized chunks; register trace roots.
-            rows_np = np.stack([
-                flatten_state(encode_state(s, dims), dims)
-                for s in init_states])
             if cfg.record_trace:
                 rhi, rlo = (np.asarray(x) for x in
                             self._fp_rows(jnp.asarray(rows_np)))
@@ -401,7 +464,7 @@ class BFSEngine:
                     trace.roots.setdefault(fp, s)
             for base in range(0, len(rows_np), B):
                 chunk = rows_np[base:base + B]
-                pad = np.zeros((B - len(chunk), sw), np.int32)
+                pad = np.zeros((B - len(chunk), sw), ROW_DTYPE)
                 valid = np.arange(B) < len(chunk)
                 (qnext, next_count, seen, n_new, fail, tr,
                  vinfo) = self._ingest(

@@ -31,7 +31,10 @@ import numpy as np
 from ..models.dims import RaftDims
 from ..models.pystate import PyState
 
-FORMAT_VERSION = 1
+# v2: frontier rows are packed uint8 (v1 stored int32 rows with no value
+# bounds; loading them into the packed engine could wrap silently, so v1
+# files are rejected rather than converted).
+FORMAT_VERSION = 2
 
 
 @dataclasses.dataclass
@@ -39,7 +42,7 @@ class Checkpoint:
     """Host-side image of a BFS engine paused at a level boundary."""
 
     dims: RaftDims
-    frontier: np.ndarray           # [cur_count, state_width] int32
+    frontier: np.ndarray           # [cur_count, state_width] uint8 rows
     seen_hi: np.ndarray            # [size] uint32, lex-sorted with seen_lo
     seen_lo: np.ndarray            # [size] uint32
     distinct: int
@@ -70,7 +73,8 @@ def save(path: str, ckpt: Checkpoint) -> None:
         np.savez_compressed(
             f,
             meta=np.frombuffer(json.dumps(meta).encode(), np.uint8),
-            frontier=np.ascontiguousarray(ckpt.frontier, np.int32),
+            frontier=np.ascontiguousarray(ckpt.frontier).astype(
+                np.uint8, casting="safe"),
             seen_hi=np.ascontiguousarray(ckpt.seen_hi, np.uint32),
             seen_lo=np.ascontiguousarray(ckpt.seen_lo, np.uint32),
             trace_fps=np.ascontiguousarray(ckpt.trace_fps, np.uint64),

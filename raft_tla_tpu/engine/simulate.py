@@ -33,8 +33,9 @@ from ..models.actions import build_expand
 from ..models.dims import RaftDims
 from ..models.invariants import build_inv_id
 from ..models.pystate import PyState
-from ..models.schema import (StateBatch, decode_state, encode_state,
-                             flatten_state, state_width, unflatten_state)
+from ..models.schema import (StateBatch, build_pack_guard, check_packable,
+                             decode_state, encode_state, flatten_state,
+                             stack_states, state_width, unflatten_state)
 
 _I32 = jnp.int32
 
@@ -63,6 +64,7 @@ class Simulator:
         inv_fns = list((invariants or {}).values())
         self.batch, self.depth, self.chunk = batch, depth, chunk
         expand = build_expand(dims)
+        pack_ok = build_pack_guard(dims)
         self._sw = state_width(dims)
         B, G, D = batch, dims.n_instances, depth
 
@@ -72,6 +74,10 @@ class Simulator:
             (rows, roots, tstep, cur_root, abuf, restarts, latch) = carry
             states = jax.vmap(unflatten_state, (0, None))(rows, dims)
             cands, en, ovf = jax.vmap(expand)(states)
+            # uint8-row wrap counts as overflow (schema.build_pack_guard):
+            # the walker restarts rather than stepping through an aliased
+            # row.  Invariants are still checked on the pre-pack candidate.
+            ovf = ovf | (en & ~jax.vmap(jax.vmap(pack_ok))(cands))
             # Uniform choice among enabled instances (masked categorical).
             logits = jnp.where(en, 0.0, -jnp.inf)
             choice = jax.random.categorical(key, logits, axis=-1)    # [B]
@@ -124,11 +130,13 @@ class Simulator:
             carry, _ = jax.lax.scan(body, carry0, keys)
             return carry
 
-        def roots_inv(rows):
-            states = jax.vmap(unflatten_state, (0, None))(rows, dims)
+        def roots_inv(batch):
+            # Takes the *unpacked* int32 StateBatch, not packed rows: uint8
+            # packing wraps out-of-range root values (engine/bfs.py
+            # build_root_check), which would mask a root TypeOK violation.
             if inv_fns:
-                return jax.vmap(inv_id)(states)
-            return jnp.full(rows.shape[:1], -1, _I32)
+                return jax.vmap(inv_id)(batch)
+            return jnp.full(batch.term.shape[:1], -1, _I32)
 
         self._chunk = jax.jit(chunk_fn, donate_argnums=(0, 4))
         self._roots_inv = jax.jit(roots_inv)
@@ -140,12 +148,10 @@ class Simulator:
         dims, B, D = self.dims, self.batch, self.depth
         res = SimResult()
         t0 = time.time()
-        roots_np = np.stack([
-            flatten_state(encode_state(s, dims), dims) for s in roots])
-        roots_j = jnp.asarray(roots_np)
+        encoded = [encode_state(s, dims) for s in roots]
         # TLC checks invariants on initial states too (so does the BFS
         # engine's ingest path); a violating root ends the run immediately.
-        rinv = np.asarray(self._roots_inv(roots_j))
+        rinv = np.asarray(self._roots_inv(stack_states(encoded)))
         if (rinv >= 0).any():
             idx = int(np.argmax(rinv >= 0))
             res.violation_state = roots[idx]
@@ -153,6 +159,10 @@ class Simulator:
             res.violation_invariant = self.inv_names[int(rinv[idx])]
             res.wall_seconds = time.time() - t0
             return res
+        for e in encoded:        # reject silently-aliasing roots
+            check_packable(e)
+        roots_np = np.stack([flatten_state(e, dims) for e in encoded])
+        roots_j = jnp.asarray(roots_np)
         key = jax.random.PRNGKey(seed)
         key, sub = jax.random.split(key)
         start = jax.random.randint(sub, (B,), 0, len(roots)).astype(_I32)

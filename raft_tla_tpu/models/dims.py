@@ -81,8 +81,12 @@ class RaftDims:
     def __post_init__(self):
         if not (1 <= self.n_servers <= 8):
             raise ValueError("n_servers must be in 1..8 (bitmask encoding)")
-        if self.n_values < 1:
-            raise ValueError("n_values must be >= 1")
+        if not (1 <= self.n_values <= 255):
+            raise ValueError("n_values must be in 1..255 (uint8 row packing)")
+        # Log indices (incl. mprevLogIndex, which can also be -1) must stay
+        # in int8 range: the uint8 row packing sign-extends that column.
+        if not (1 <= self.max_log <= 127):
+            raise ValueError("max_log must be in 1..127 (uint8 row packing)")
 
     # -- derived widths ----------------------------------------------------
     @property

@@ -84,6 +84,37 @@ def decode_message(w: np.ndarray, dims: RaftDims) -> tuple:
     return (3, src, dst, mterm, int(w[4]), int(w[5]))
 
 
+def check_packable(st: "StateBatch") -> None:
+    """Raise if any field value cannot round-trip the uint8 row packing.
+
+    Host-side, roots only; kernel-produced successors are guarded by
+    ``build_pack_guard``.  Engines call this *after* the pre-pack root
+    invariant check, so a root that an invariant would flag (e.g.
+    matchIndex = -1 under TypeOK) is reported as the violation it is; this
+    guard only rejects roots that would otherwise alias silently.  ``msg``
+    column 4 — the one sign-extended field — admits [-128, 127]; every
+    other value is unsigned [0, 255]."""
+    for name, arr in zip(StateBatch._fields, st):
+        a = np.asarray(arr)
+        if a.size == 0:
+            continue
+        if name == "msg":
+            col4 = a[..., 4]
+            rest = np.delete(a, 4, axis=-1)
+            if ((col4 < -128).any() or (col4 > 127).any()
+                    or (rest.size and ((rest < 0).any()
+                                       or (rest > 255).any()))):
+                raise ValueError(
+                    "state field 'msg' has value outside the packable "
+                    "range (column 4: [-128, 127]; others: [0, 255]): "
+                    f"col4 [{int(col4.min())}, {int(col4.max())}], "
+                    f"rest [{int(rest.min())}, {int(rest.max())}]")
+        elif int(a.min()) < 0 or int(a.max()) > 255:
+            raise ValueError(
+                f"state field {name!r} has value outside the packable "
+                f"range [0, 255]: min={int(a.min())}, max={int(a.max())}")
+
+
 def encode_state(s: PyState, dims: RaftDims) -> StateBatch:
     """PyState -> single-state StateBatch (numpy int32, no leading axis)."""
     n, L, M = dims.n_servers, dims.max_log, dims.n_msg_slots
@@ -146,8 +177,20 @@ def decode_state(st: StateBatch, dims: RaftDims) -> PyState:
 
 
 # ---------------------------------------------------------------------------
-# Flat row form: the BFS queues store states as [state_width] int32 rows
+# Flat row form: the BFS queues store states as [state_width] uint8 rows
 # (one concatenation of every field); cheap reshape/concat both ways.
+#
+# uint8 is sufficient for every field under the target bounds (terms <=
+# MaxTerm, log values <= |Value|, nextIndex <= Lmax+1, N<=8 vote bitmasks
+# <= 255) and packs 4x more states per byte of HBM/ICI than int32.  The one
+# field that can be negative is message payload column 4 (mprevLogIndex,
+# raft.tla:454 — SmokeInt reaches -1, Smokeraft.tla:14-15): it is stored
+# two's-complement (-1 -> 255) and sign-extended on decode; every other
+# field is unsigned and < 128 under any budgeted run (a Smokeraft diameter
+# budget of 100 bounds term growth at ~103).
+
+ROW_DTYPE = np.uint8
+
 
 def state_width(dims: RaftDims) -> int:
     n, L, M, W = (dims.n_servers, dims.max_log, dims.n_msg_slots,
@@ -155,8 +198,29 @@ def state_width(dims: RaftDims) -> int:
     return n * 7 + 2 * n * L + 2 * n * n + M * W + M
 
 
+def build_pack_guard(dims: RaftDims):
+    """Per-state predicate: every unbounded-growth field still fits the
+    uint8 row.  Terms grow via Timeout (raft.tla:146), bag counts via
+    DuplicateMessage (:410), and message terms follow sender terms; all
+    other fields are bounded by dims by construction.  Engines OR the
+    negation into their overflow mask, so wrap-around is a hard error,
+    never silent state aliasing."""
+    import jax.numpy as jnp
+
+    def pack_ok(st: StateBatch):
+        # Column 4 is sign-extended on decode (mprevLogIndex for AEReq, but
+        # mlastLogTerm for RVReq), so values >= 128 there would corrupt to
+        # negatives: bound it at 127, unlike the unsigned 255 elsewhere.
+        return (jnp.all(st.term <= 255)
+                & jnp.all(st.msg_cnt <= 255)
+                & jnp.all(st.msg[:, 3] <= 255)
+                & jnp.all(st.msg[:, 4] <= 127))
+
+    return pack_ok
+
+
 def flatten_state(st: StateBatch, dims: RaftDims):
-    """StateBatch (single state) -> [state_width] int32 row.  Works under
+    """StateBatch (single state) -> [state_width] uint8 row.  Works under
     vmap for batches.  Import-free of jax: uses the array namespace of its
     inputs (numpy or jnp)."""
     parts = [st.term, st.role, st.voted_for, st.log_term.reshape(-1),
@@ -165,15 +229,22 @@ def flatten_state(st: StateBatch, dims: RaftDims):
              st.match_idx.reshape(-1), st.msg.reshape(-1), st.msg_cnt]
     if isinstance(st.term, np.ndarray):
         return np.concatenate([np.asarray(p, np.int32).reshape(-1)
-                               for p in parts])
+                               for p in parts]).astype(ROW_DTYPE)
     import jax.numpy as jnp  # jax arrays and tracers
-    return jnp.concatenate(parts)
+    return jnp.concatenate(parts).astype(jnp.uint8)
 
 
 def unflatten_state(row, dims: RaftDims) -> StateBatch:
-    """[state_width] int32 row -> StateBatch.  Works under vmap."""
+    """[state_width] uint8 row -> StateBatch (int32 fields).  Works under
+    vmap.  Tolerates int32 input rows (pre-packing callers) — the signed
+    fix-up below is a no-op for values already < 128."""
     n, L, M, W = (dims.n_servers, dims.max_log, dims.n_msg_slots,
                   dims.msg_width)
+    if isinstance(row, np.ndarray):
+        import numpy as xp
+    else:
+        import jax.numpy as xp
+    row = row.astype(xp.int32)
     sizes = [n, n, n, n * L, n * L, n, n, n, n, n * n, n * n, M * W, M]
     shapes = [(n,), (n,), (n,), (n, L), (n, L), (n,), (n,), (n,), (n,),
               (n, n), (n, n), (M, W), (M,)]
@@ -181,4 +252,9 @@ def unflatten_state(row, dims: RaftDims) -> StateBatch:
     for sz, shp in zip(sizes, shapes):
         out.append(row[off:off + sz].reshape(shp))
         off += sz
+    # Sign-extend message payload column 4 (mprevLogIndex — the only field
+    # that can be negative; stored two's-complement in the uint8 row).
+    msg = out[11]
+    col4 = (xp.arange(W) == 4)[None, :]
+    out[11] = xp.where(col4 & (msg >= 128), msg - 256, msg)
     return StateBatch(*out)

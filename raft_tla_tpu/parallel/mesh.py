@@ -37,12 +37,14 @@ import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
 
 from ..engine.bfs import (EngineConfig, EngineResult, TraceStore, Violation,
+                          build_root_check, find_root_violation,
                           make_trace_store)
 from ..models.actions import build_expand
 from ..models.dims import RaftDims
 from ..models.invariants import build_inv_id
 from ..models.pystate import PyState
-from ..models.schema import (decode_state, encode_state, flatten_state,
+from ..models.schema import (ROW_DTYPE, build_pack_guard, check_packable,
+                             decode_state, encode_state, flatten_state,
                              state_width, unflatten_state)
 from ..ops import fpset
 from ..ops.fingerprint import SENTINEL, build_fingerprint
@@ -69,6 +71,7 @@ class MeshBFSEngine:
         inv_fns = list((invariants or {}).values())
         expand = build_expand(dims)
         fingerprint = build_fingerprint(dims)
+        pack_ok = build_pack_guard(dims)
         sw = state_width(dims)
         B, G = cfg.batch, dims.n_instances
         K = B * G
@@ -156,7 +159,9 @@ class MeshBFSEngine:
             states = jax.vmap(unflatten_state, (0, None))(rows, dims)
             cands, en, ovf = jax.vmap(expand)(states)
             en = en & valid[:, None]
-            ovf = ovf & valid[:, None]
+            # uint8-row wrap guard (schema.build_pack_guard): hard overflow.
+            ovf = (ovf | (en & ~jax.vmap(jax.vmap(pack_ok))(cands))) \
+                & valid[:, None]
             dead = valid & ~jnp.any(en, axis=1) & ~jnp.any(ovf, axis=1)
             dead_any = jnp.any(dead)
             drow = rows[jnp.argmax(dead)]
@@ -223,6 +228,8 @@ class MeshBFSEngine:
         self._fp_rows = jax.jit(fp_rows)
         self._expand1 = jax.jit(expand)
         self._fp_batch = jax.jit(jax.vmap(fingerprint))
+        self._root_check = (build_root_check(inv_fns, fingerprint)
+                            if inv_fns else None)
 
     # ------------------------------------------------------------------
     def run(self, init_states: List[PyState]) -> EngineResult:
@@ -232,15 +239,26 @@ class MeshBFSEngine:
         trace = make_trace_store() if cfg.record_trace else TraceStore()
         self.trace = trace
 
-        qcur = jnp.zeros((n, QL, sw), _I32)
-        qnext = jnp.zeros((n, QL, sw), _I32)
+        qcur = jnp.zeros((n, QL, sw), jnp.uint8)
+        qnext = jnp.zeros((n, QL, sw), jnp.uint8)
         shi = jnp.full((n, CL), SENTINEL, _U32)
         slo = jnp.full((n, CL), SENTINEL, _U32)
         ssize = jnp.zeros((n,), _I32)
         next_counts = jnp.zeros((n,), _I32)
 
-        rows_np = np.stack([
-            flatten_state(encode_state(s, dims), dims) for s in init_states])
+        encoded = [encode_state(s, dims) for s in init_states]
+        # Pre-pack invariant check (engine/bfs.py build_root_check).
+        if self._root_check is not None:
+            v = find_root_violation(self._root_check, encoded, init_states,
+                                    B, self.inv_names)
+            if v is not None:     # before warm-up: no checking time elapsed
+                res.violation = v
+                res.stop_reason = "violation"
+                res.levels.append(0)
+                return res
+        for e in encoded:         # reject silently-aliasing roots
+            check_packable(e)
+        rows_np = np.stack([flatten_state(e, dims) for e in encoded])
         if cfg.record_trace:
             rhi, rlo = (np.asarray(x) for x in
                         self._fp_rows(jnp.asarray(rows_np)))
@@ -249,7 +267,7 @@ class MeshBFSEngine:
                     (int(rhi[idx]) << 32) | int(rlo[idx]), s)
 
         # Warm-up compilation before the duration clock starts.
-        out = self._ingest(jnp.zeros((n, B, sw), _I32),
+        out = self._ingest(jnp.zeros((n, B, sw), jnp.uint8),
                            jnp.zeros((n, B), bool),
                            qnext, next_counts, shi, slo, ssize)
         qnext, next_counts, shi, slo, ssize = out[:5]
@@ -262,7 +280,7 @@ class MeshBFSEngine:
         per_chip = [rows_np[i::n] for i in range(n)]
         max_chunks = max((-(-len(p) // B) for p in per_chip), default=0)
         for c in range(max_chunks):
-            wave = np.zeros((n, B, sw), np.int32)
+            wave = np.zeros((n, B, sw), ROW_DTYPE)
             valid = np.zeros((n, B), bool)
             for d in range(n):
                 part = per_chip[d][c * B:(c + 1) * B]
