@@ -61,8 +61,13 @@ _I32 = jnp.int32
 @dataclasses.dataclass
 class EngineConfig:
     batch: int = 256             # states expanded per device step
-    queue_capacity: int = 1 << 16
-    seen_capacity: int = 1 << 18
+    # None => size from the device's reported HBM (see _auto_capacities).
+    # Neither is a hard limit on the state space: the frontier spills to
+    # host memory when the device queue fills (TLC's disk queue), and the
+    # seen-set grows by rehashing when its load factor passes the
+    # threshold; these set the *device-resident* working set.
+    queue_capacity: Optional[int] = 1 << 16
+    seen_capacity: Optional[int] = 1 << 18
     check_deadlock: bool = True
     record_trace: bool = True
     sync_every: int = 32         # device batches per host round-trip
@@ -122,6 +127,33 @@ def build_root_check(inv_fns, fingerprint):
     return jax.jit(check)
 
 
+def _auto_capacities(sw: int, batch: int,
+                     record_trace: bool) -> Tuple[int, int]:
+    """(queue rows, seen keys) sized from the device's reported HBM.
+
+    Budget (after a 25% headroom for XLA temporaries and the candidate
+    buffers): half to the two level queues (+ trace buffer when tracing),
+    a quarter to the fingerprint table (8 B/slot).  TLC has no equivalent
+    — its queue and FPSet page to disk; here the spill path plays that
+    role and these sizes only set the device-resident working set.
+    Falls back to modest defaults when the backend reports no limit
+    (virtual CPU devices)."""
+    limit = None
+    try:
+        stats = jax.devices()[0].memory_stats()
+        if stats:
+            limit = int(stats.get("bytes_limit", 0)) or None
+    except Exception:
+        limit = None
+    if limit is None:
+        return 1 << 20, 1 << 22
+    usable = int(limit * 0.75)
+    row_cost = 2 * sw + (20 if record_trace else 0)   # queues + trace row
+    q = max(batch, min(usable // 2 // row_cost, 1 << 25))
+    s = max(1 << 18, min(usable // 4 // 8, 1 << 28))
+    return q, s
+
+
 def find_root_violation(root_check, encoded, init_states, batch_size,
                         inv_names) -> Optional[Violation]:
     """Run ``build_root_check``'s program over the encoded roots in
@@ -158,11 +190,25 @@ class BFSEngine:
         pack_ok = build_pack_guard(dims)
         sw = state_width(dims)
         B, G = cfg.batch, dims.n_instances
+        qreq, sreq = cfg.queue_capacity, cfg.seen_capacity
+        if qreq is None or sreq is None:
+            auto_q, auto_s = _auto_capacities(sw, B, cfg.record_trace)
+            qreq = auto_q if qreq is None else qreq
+            sreq = auto_s if sreq is None else sreq
+        # The table is floored at 8 worst-case batches of keys: the device
+        # loop stops for growth at half-full, so a single batch can then
+        # push the load at most to 1/2 + 1/8 — far from where double-hash
+        # probes start failing.  (fpset rounds up to a power of two.)
+        self._seen_cap = max(sreq, 8 * B * G)
         # Queue offsets advance in whole batches; capacity must be a
         # multiple of batch so dynamic_slice never clamps (which would
-        # silently shift the window off the intended rows).  Rounded copy
-        # kept on self — the caller's config is not mutated.
-        Q = -(-cfg.queue_capacity // B) * B
+        # silently shift the window off the intended rows).  It is also
+        # floored at one worst-case batch (B*G rows, every candidate new):
+        # a single batch may never overflow the queue, because the enqueue
+        # scatter drops out-of-range rows — the spill watermark can only
+        # guard *between* batches.  Rounded copy kept on self — the
+        # caller's config is not mutated.
+        Q = max(-(-qreq // B) * B, B * G)
         self._sw, self._B, self._G, self._Q = sw, B, G, Q
 
         def absorb(crows, en, parent_hi, parent_lo, actions,
@@ -226,8 +272,21 @@ class BFSEngine:
         CH = max(1, cfg.sync_every)
         # Trace-buffer rows: enough that a fresh chunk (tcount=0) always
         # has room for >= 1 batch, else the loop could make no progress.
-        TQ = Q + B * G
+        # With tracing off the buffers shrink to stubs and every trace
+        # scatter (and the parents-only fingerprint pass) compiles out —
+        # raw-throughput runs pay nothing for the feature.
+        record_static = cfg.record_trace
+        TQ = Q + B * G if record_static else 8
         check_deadlock_static = cfg.check_deadlock
+        # The next-level queue must always have room for one worst-case
+        # batch (every instance of every state new): the device loop stops
+        # at this watermark and the host spills the queue to its memory
+        # (TLC's disk-backed state queue, SURVEY §2.4 R8).  Q >= B*G, so a
+        # batch always runs when the count is at/below the watermark and
+        # can never overflow; when Q == B*G exactly (tiny test configs)
+        # every batch triggers a spill — correct, just not fast.
+        QTH = Q - B * G
+        self._QTH = QTH
 
         def chunk_body(qcur, cur_count, carry):
             (offset, steps, qnext, next_count, seen, tbuf, tcount,
@@ -249,12 +308,6 @@ class BFSEngine:
             cflat = jax.tree.map(
                 lambda a: a.reshape((B * G,) + a.shape[2:]), cands)
             crows = jax.vmap(flatten_state, (0, None))(cflat, dims)
-            php, plp = jax.vmap(fingerprint)(states)     # parent fps [B]
-            k_idx = jnp.arange(B * G, dtype=_I32)
-            parent_hi = php[k_idx // G]
-            parent_lo = plp[k_idx // G]
-            actions = k_idx % G
-
             cands2 = jax.vmap(unflatten_state, (0, None))(crows, dims)
             fph, fpl = jax.vmap(fingerprint)(cands2)
             enf = en.reshape(-1)
@@ -278,13 +331,19 @@ class BFSEngine:
             qnext = qnext.at[pos].set(crows, mode="drop")
             next_count = next_count + jnp.sum(enq, dtype=_I32)
 
-            tpos = jnp.where(new, tcount + jnp.cumsum(new.astype(_I32)) - 1,
-                             TQ)
-            tbuf = tuple(
-                buf.at[tpos].set(col, mode="drop")
-                for buf, col in zip(
-                    tbuf, (fph, fpl, parent_hi, parent_lo, actions)))
-            tcount = tcount + jnp.sum(new, dtype=_I32)
+            if record_static:
+                php, plp = jax.vmap(fingerprint)(states)  # parent fps [B]
+                k_idx = jnp.arange(B * G, dtype=_I32)
+                parent_hi = php[k_idx // G]
+                parent_lo = plp[k_idx // G]
+                actions = k_idx % G
+                tpos = jnp.where(
+                    new, tcount + jnp.cumsum(new.astype(_I32)) - 1, TQ)
+                tbuf = tuple(
+                    buf.at[tpos].set(col, mode="drop")
+                    for buf, col in zip(
+                        tbuf, (fph, fpl, parent_hi, parent_lo, actions)))
+                tcount = tcount + jnp.sum(new, dtype=_I32)
 
             take_v = ~viol_any & viol_any_b
             vinv = jnp.where(take_v, inv[vpos], vinv)
@@ -310,15 +369,23 @@ class BFSEngine:
                     jnp.uint32(0), jnp.uint32(0), jnp.bool_(False))
 
             def cond(c):
-                (offset, steps, _qn, next_count, _seen, _tb, tcount,
+                (offset, steps, _qn, next_count, seen_c, _tb, tcount,
                  _g, _n, ovfc, dead_any, _dr, viol_any, _vi, _vr, _vh,
                  _vl, fail_any) = c
                 more = (offset < cur_count) & (steps < CH)
-                room = tcount <= TQ - B * G
+                qroom = next_count <= QTH       # host spills past this
+                # Stop for growth at half-full: the host doubles the table
+                # before the load can reach probe-failure territory.  A
+                # chunk always enters at <= half-full (growth guarantees
+                # it), so its first batch always runs.
+                sroom = seen_c.size <= seen_c.hi.shape[0] // 2
                 stop = viol_any | (ovfc > 0) | fail_any
                 if check_deadlock_static:
                     stop = stop | dead_any
-                return more & room & ~stop
+                cont = more & qroom & sroom & ~stop
+                if record_static:
+                    cont = cont & (tcount <= TQ - B * G)
+                return cont
 
             out = jax.lax.while_loop(
                 cond, lambda c: chunk_body(qcur, cur_count, c), init)
@@ -364,6 +431,7 @@ class BFSEngine:
         elif init_states is None:
             raise ValueError("need init_states or resume")
         res = EngineResult()
+        t_enter = time.time()   # for early returns before the budget clock
         # Trace recording off => plain dict store (never written); avoids
         # triggering the native build for runs that measure raw throughput.
         trace = make_trace_store() if cfg.record_trace else TraceStore()
@@ -381,6 +449,7 @@ class BFSEngine:
                     res.violation = v
                     res.stop_reason = "violation"
                     res.levels.append(0)
+                    res.wall_seconds = time.time() - t_enter
                     return res
             # Only now reject unpackable roots (see schema.check_packable:
             # an invariant-flagged root is a violation, not an error).
@@ -390,8 +459,14 @@ class BFSEngine:
 
         qcur = jnp.zeros((Q, sw), jnp.uint8)
         qnext = jnp.zeros((Q, sw), jnp.uint8)
-        seen = fpset.empty(cfg.seen_capacity)
+        seen = fpset.empty(self._seen_cap)
         next_count = jnp.int32(0)
+        # Host-resident level segments: the part of the current level that
+        # does not fit the device queue (``pending``) and next-level
+        # overflow drained mid-level (``spill_next``) — TLC's disk-backed
+        # state queue, in host RAM.
+        pending: List[np.ndarray] = []
+        spill_next: List[np.ndarray] = []
         TQ = self._TQ
         tbuf = (jnp.zeros((TQ,), jnp.uint32), jnp.zeros((TQ,), jnp.uint32),
                 jnp.zeros((TQ,), jnp.uint32), jnp.zeros((TQ,), jnp.uint32),
@@ -415,17 +490,16 @@ class BFSEngine:
             # into a fresh hash table, reload the frontier, counters, and
             # trace records/roots.
             n_keys = resume.seen_hi.shape[0]
-            if n_keys > cfg.seen_capacity:
-                raise RuntimeError(
-                    f"checkpoint has {n_keys} seen keys > seen_capacity "
-                    f"{cfg.seen_capacity}")
-            seen = fpset.from_host_keys(resume.seen_hi, resume.seen_lo,
-                                        cfg.seen_capacity)
+            cap = self._seen_cap
+            while n_keys > fpset._capacity(cap) // 2:
+                cap *= 2
+            seen = fpset.from_host_keys(resume.seen_hi, resume.seen_lo, cap)
             fr = np.ascontiguousarray(resume.frontier).astype(
                 ROW_DTYPE, casting="safe")
-            if len(fr) > Q:
-                raise RuntimeError(
-                    f"checkpoint frontier {len(fr)} > queue capacity {Q}")
+            # A frontier larger than the device queue resumes as device
+            # rows + host segments (same split the spill path produces).
+            pending = [fr[i:i + Q] for i in range(Q, len(fr), Q)]
+            fr = fr[:Q]
             qcur = jnp.zeros((Q, sw), jnp.uint8).at[:len(fr)].set(
                 jnp.asarray(fr))
             cur_count = len(fr)
@@ -472,19 +546,26 @@ class BFSEngine:
                     jnp.asarray(valid), qnext, next_count, seen)
                 res.distinct += int(n_new)
                 self._record(trace, tr, int(n_new))
-                if int(next_count) > Q:
+                if bool(fail):
                     raise RuntimeError(
-                        "queue capacity exceeded by initial states")
-                if bool(fail) or int(seen.size) > cfg.seen_capacity:
-                    raise RuntimeError("seen-set capacity exceeded")
+                        "seen-set probe failure during ingest; raise "
+                        "seen_capacity")
+                seen = self._maybe_grow_seen(seen, int(seen.size))
+                nc = int(next_count)
+                if nc > self._QTH:      # spill: ingest adds <= B per call,
+                    spill_next.append(  # so the watermark is never blown
+                        np.asarray(qnext[:nc]).copy())
+                    next_count = jnp.int32(0)
                 if self._check_violation(res, vinfo):
                     break
 
             # levels[] counts enqueued (constraint-passing) states per
             # level, mirroring the oracle's frontier sizes.
-            res.levels.append(int(next_count))
+            res.levels.append(int(next_count)
+                              + sum(len(s) for s in spill_next))
             qcur, qnext = qnext, qcur
             cur_count = int(next_count)
+            pending, spill_next = spill_next, []
             next_count = jnp.int32(0)
 
         # A resumed run must not rewrite the snapshot it just loaded (a
@@ -492,15 +573,15 @@ class BFSEngine:
         # empty trace), and its interval clock starts at the restart.
         skip_ckpt_level = resume.diameter if resume is not None else -1
         last_ckpt = time.time() if resume is not None else float("-inf")
-        while cur_count > 0 and res.violation is None \
+        while (cur_count > 0 or pending) and res.violation is None \
                 and res.stop_reason == "exhausted":
             if cfg.checkpoint_dir is not None \
                     and res.diameter % max(1, cfg.checkpoint_every) == 0 \
                     and res.diameter != skip_ckpt_level \
                     and (time.time() - last_ckpt
                          >= cfg.checkpoint_interval_seconds):
-                self._write_checkpoint(qcur, cur_count, seen, res, trace,
-                                       wall=time.time() - t0)
+                self._write_checkpoint(qcur, cur_count, pending, seen, res,
+                                       trace, wall=time.time() - t0)
                 last_ckpt = time.time()
             if cfg.max_diameter is not None \
                     and res.diameter >= cfg.max_diameter:
@@ -509,59 +590,83 @@ class BFSEngine:
             # Level loop: each _chunk call runs up to sync_every batches on
             # device; ONE packed stats fetch (plus a trace flush) per call
             # is the only host traffic — the tunnel round-trip no longer
-            # bounds states/sec.
-            offset = 0
+            # bounds states/sec.  The outer loop walks the level's
+            # segments: first the device-resident rows, then any host
+            # segments left by the previous level's spill.
             next_count_h = 0
-            while offset < cur_count:
-                out = self._chunk(qcur, jnp.int32(cur_count),
-                                  jnp.int32(offset), qnext,
-                                  jnp.int32(next_count_h), seen, tbuf,
-                                  jnp.int32(0))
-                qnext, seen, tbuf = out[0], out[1], out[2]
-                st = np.asarray(out[3])
-                offset, next_count_h = int(st[0]), int(st[2])
-                seen_size, tcount = int(st[3]), int(st[4])
-                n_gen, n_new, n_ovf = int(st[5]), int(st[6]), int(st[7])
-                dead_any, viol_any = bool(st[8]), bool(st[9])
-                vinv, fail = int(st[10]), bool(st[11])
-                res.distinct += n_new
-                res.generated += n_gen
-                if cfg.record_trace and tcount:
-                    self._flush_trace(trace, tbuf, tcount)
-                if n_ovf:
-                    raise RuntimeError(
-                        f"{n_ovf} successors exceeded fixed-width capacity "
-                        f"(max_log={dims.max_log}, n_msg_slots="
-                        f"{dims.n_msg_slots}); rerun with larger capacities")
-                if fail or seen_size > cfg.seen_capacity:
-                    raise RuntimeError("seen-set capacity exceeded")
-                if next_count_h > Q:
-                    raise RuntimeError("queue capacity exceeded")
-                if viol_any:
-                    vrow, vhl = np.asarray(out[5]), np.asarray(out[6])
-                    res.violation = Violation(
-                        invariant=self.inv_names[vinv],
-                        state=decode_state(
-                            unflatten_state(vrow, dims), dims),
-                        fingerprint=(int(vhl[0]) << 32) | int(vhl[1]))
-                    res.stop_reason = "violation"
+            while True:
+                offset = 0
+                while offset < cur_count:
+                    out = self._chunk(qcur, jnp.int32(cur_count),
+                                      jnp.int32(offset), qnext,
+                                      jnp.int32(next_count_h), seen, tbuf,
+                                      jnp.int32(0))
+                    qnext, seen, tbuf = out[0], out[1], out[2]
+                    st = np.asarray(out[3])
+                    offset, next_count_h = int(st[0]), int(st[2])
+                    seen_size, tcount = int(st[3]), int(st[4])
+                    n_gen, n_new, n_ovf = int(st[5]), int(st[6]), int(st[7])
+                    dead_any, viol_any = bool(st[8]), bool(st[9])
+                    vinv, fail = int(st[10]), bool(st[11])
+                    res.distinct += n_new
+                    res.generated += n_gen
+                    if cfg.record_trace and tcount:
+                        self._flush_trace(trace, tbuf, tcount)
+                    if n_ovf:
+                        raise RuntimeError(
+                            f"{n_ovf} successors exceeded fixed-width "
+                            f"capacity (max_log={dims.max_log}, n_msg_slots"
+                            f"={dims.n_msg_slots}) or wrapped the uint8 "
+                            f"row; rerun with larger capacities/bounds")
+                    if fail:
+                        raise RuntimeError(
+                            "seen-set probe failure (load spiked past the "
+                            "growth threshold within one chunk); raise "
+                            "seen_capacity or lower sync_every")
+                    seen = self._maybe_grow_seen(seen, seen_size)
+                    if next_count_h > self._QTH \
+                            and (offset < cur_count or pending):
+                        # Next-level queue at the watermark with more of
+                        # this level still to expand: drain it to host
+                        # (TLC's disk queue) and keep going.
+                        spill_next.append(
+                            np.asarray(qnext[:next_count_h]).copy())
+                        next_count_h = 0
+                    if viol_any:
+                        vrow, vhl = np.asarray(out[5]), np.asarray(out[6])
+                        res.violation = Violation(
+                            invariant=self.inv_names[vinv],
+                            state=decode_state(
+                                unflatten_state(vrow, dims), dims),
+                            fingerprint=(int(vhl[0]) << 32) | int(vhl[1]))
+                        res.stop_reason = "violation"
+                        break
+                    if dead_any and cfg.check_deadlock:
+                        res.deadlock = decode_state(
+                            unflatten_state(np.asarray(out[4]), dims), dims)
+                        res.stop_reason = "deadlock"
+                        break
+                    if (cfg.max_seconds is not None
+                            and time.time() - t0 > cfg.max_seconds):
+                        res.stop_reason = "duration_budget"
+                        break
+                if res.stop_reason != "exhausted" \
+                        or res.violation is not None or not pending:
                     break
-                if dead_any and cfg.check_deadlock:
-                    res.deadlock = decode_state(
-                        unflatten_state(np.asarray(out[4]), dims), dims)
-                    res.stop_reason = "deadlock"
-                    break
-                if (cfg.max_seconds is not None
-                        and time.time() - t0 > cfg.max_seconds):
-                    res.stop_reason = "duration_budget"
-                    break
+                # Upload the next host segment of this level.
+                seg = pending.pop(0)
+                buf = np.zeros((Q, sw), ROW_DTYPE)
+                buf[:len(seg)] = seg
+                qcur = jax.device_put(buf, qcur.devices().pop())
+                cur_count = len(seg)
             if res.stop_reason != "exhausted" or res.violation is not None:
                 break  # aborted mid-level: diameter counts completed levels
             res.diameter += 1
-            res.levels.append(next_count_h)
+            res.levels.append(next_count_h
+                              + sum(len(s) for s in spill_next))
             qcur, qnext = qnext, qcur
             cur_count = next_count_h
-            next_count = jnp.int32(0)
+            pending, spill_next = spill_next, []
 
         res.wall_seconds = time.time() - t0
         return res
@@ -606,7 +711,22 @@ class BFSEngine:
         return out
 
     # ------------------------------------------------------------------
-    def _write_checkpoint(self, qcur, cur_count, seen, res, trace, wall):
+    def _maybe_grow_seen(self, seen, size=None):
+        """Double the FPSet (rehash through host keys) once load passes
+        0.5 — early enough that the insertions of the next chunk (checked
+        only at host sync points) fit the free half without pushing the
+        load where probes start failing.  The chunk program recompiles for
+        the new table shape, so growth costs one compile per doubling;
+        auto-sized tables (seen_capacity=None) start large enough that
+        most runs never grow."""
+        C = seen.hi.shape[0]
+        if (int(seen.size) if size is None else size) <= C // 2:
+            return seen
+        hi, lo = fpset.to_host_keys(seen)
+        return fpset.from_host_keys(hi, lo, 2 * C)
+
+    def _write_checkpoint(self, qcur, cur_count, pending, seen, res, trace,
+                          wall):
         from . import checkpoint as ckpt_mod
         import os
         if self.config.record_trace:
@@ -618,9 +738,12 @@ class BFSEngine:
             ta = np.empty(0, np.int32)
             roots = {}
         seen_hi, seen_lo = fpset.to_host_keys(seen)
+        frontier = np.asarray(qcur[:cur_count])
+        if pending:
+            frontier = np.concatenate([frontier] + list(pending))
         ck = ckpt_mod.Checkpoint(
             dims=self.dims,
-            frontier=np.asarray(qcur[:cur_count]),
+            frontier=frontier,
             seen_hi=seen_hi, seen_lo=seen_lo,
             distinct=res.distinct, generated=res.generated,
             diameter=res.diameter, levels=tuple(res.levels),

@@ -75,11 +75,20 @@ class MeshBFSEngine:
         sw = state_width(dims)
         B, G = cfg.batch, dims.n_instances
         K = B * G
-        # Per-chip capacities.
-        per_chip = -(-cfg.queue_capacity // n)
+        # Per-chip capacities.  None resolves through the same HBM
+        # auto-sizing as the single-chip engine (per-chip budget); unlike
+        # it, the mesh engine does not yet spill or grow — overflow is a
+        # hard error here until the spill path lands in this engine too.
+        from ..engine.bfs import _auto_capacities
+        qreq, sreq = cfg.queue_capacity, cfg.seen_capacity
+        if qreq is None or sreq is None:
+            auto_q, auto_s = _auto_capacities(sw, B, cfg.record_trace)
+            qreq = auto_q if qreq is None else qreq
+            sreq = auto_s if sreq is None else sreq
+        per_chip = -(-qreq // n)
         QL = max(B, -(-per_chip // B) * B)   # round up to a batch multiple
         # Per-chip hash-table shard: power of two for masked probing.
-        CL = fpset._capacity(-(-cfg.seen_capacity // n))
+        CL = fpset._capacity(-(-sreq // n))
         self._sw, self._B, self._QL, self._CL = sw, B, QL, CL
 
         def local_absorb(crows, cands, en, parent_hi, parent_lo, actions,
@@ -236,6 +245,7 @@ class MeshBFSEngine:
         dims, cfg = self.dims, self.config
         n, sw, B, QL, CL = self.n_dev, self._sw, self._B, self._QL, self._CL
         res = EngineResult()
+        t_enter = time.time()   # for early returns before the budget clock
         trace = make_trace_store() if cfg.record_trace else TraceStore()
         self.trace = trace
 
@@ -255,6 +265,7 @@ class MeshBFSEngine:
                 res.violation = v
                 res.stop_reason = "violation"
                 res.levels.append(0)
+                res.wall_seconds = time.time() - t_enter
                 return res
         for e in encoded:         # reject silently-aliasing roots
             check_packable(e)

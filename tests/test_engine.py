@@ -131,3 +131,63 @@ def test_duration_budget_stops():
     res = eng.run([init_state(DIMS)])
     assert res.stop_reason == "duration_budget"
     assert res.distinct >= 1
+
+
+def test_spill_to_host_matches_unspilled():
+    """Frontier overflow must spill to host memory (TLC's disk queue) and
+    change nothing observable: a run whose device queue is far smaller than
+    the peak level size must report exactly the counts of a roomy run."""
+    roomy = BFSEngine(DIMS, constraint=build_constraint(DIMS, BOUNDS),
+                      config=small_config(max_diameter=4))
+    want = roomy.run([init_state(DIMS)])
+    # Peak level through diameter 4 is >> 64 rows, so this run spills
+    # (queue_capacity rounds up to one batch = 32 rows; watermark is 0).
+    tiny = BFSEngine(DIMS, constraint=build_constraint(DIMS, BOUNDS),
+                     config=small_config(batch=32, queue_capacity=32,
+                                         max_diameter=4, record_trace=False))
+    got = tiny.run([init_state(DIMS)])
+    assert got.distinct == want.distinct
+    assert got.levels == want.levels
+    assert got.generated == want.generated
+    assert got.diameter == want.diameter
+
+
+def test_seen_set_grows_in_place():
+    """The FPSet must double (rehash) as load passes the threshold instead
+    of dying; counts stay exact across growths."""
+    roomy = BFSEngine(DIMS, constraint=build_constraint(DIMS, BOUNDS),
+                      config=small_config(max_diameter=3))
+    want = roomy.run([init_state(DIMS)])
+    # batch 8 / sync 1 keeps per-host-check insertions well under the free
+    # half of the table, so growth always fires before probes could fail.
+    small = BFSEngine(DIMS, constraint=build_constraint(DIMS, BOUNDS),
+                      config=small_config(batch=8, sync_every=1,
+                                          seen_capacity=256, max_diameter=3))
+    got = small.run([init_state(DIMS)])
+    assert got.distinct == want.distinct
+    assert got.levels == want.levels
+
+
+def test_checkpoint_resume_across_spill(tmp_path):
+    """A checkpoint written while part of the level lives in host spill
+    segments must resume bit-exactly."""
+    ck = str(tmp_path / "ck")
+    full = BFSEngine(DIMS, constraint=build_constraint(DIMS, BOUNDS),
+                     config=small_config(max_diameter=4, record_trace=False))
+    want = full.run([init_state(DIMS)])
+    first = BFSEngine(DIMS, constraint=build_constraint(DIMS, BOUNDS),
+                      config=small_config(batch=32, queue_capacity=32,
+                                          max_diameter=3, record_trace=False,
+                                          checkpoint_dir=ck))
+    first.run([init_state(DIMS)])
+    from raft_tla_tpu.engine import checkpoint as ckpt_mod
+    path = ckpt_mod.latest(ck)
+    assert path is not None
+    second = BFSEngine(DIMS, constraint=build_constraint(DIMS, BOUNDS),
+                       config=small_config(batch=32, queue_capacity=32,
+                                           max_diameter=4,
+                                           record_trace=False))
+    got = second.run(resume=path)
+    assert got.distinct == want.distinct
+    assert got.levels == want.levels
+    assert got.diameter == want.diameter
