@@ -23,7 +23,10 @@ import time
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
 BENCH_SECONDS = float(os.environ.get("BENCH_SECONDS", "45"))
-ORACLE_SECONDS = float(os.environ.get("BENCH_ORACLE_SECONDS", "5"))
+# Oracle window defaults to the engine's budget: comparable measurement
+# windows (both all-fresh early levels first, duplicates later).
+ORACLE_SECONDS = float(os.environ.get("BENCH_ORACLE_SECONDS",
+                                      str(BENCH_SECONDS)))
 
 
 def main():
@@ -57,7 +60,11 @@ def main():
     res = engine.run(initial_states(setup))
     rate = res.distinct / res.wall_seconds if res.wall_seconds else 0.0
 
-    # Python-oracle baseline on the same model (CPU, single core).
+    # Python-oracle baseline on the same model (CPU, single core), over
+    # the SAME wall budget from the same root — comparable windows, so the
+    # ratio measures engine speed, not space structure (round-2 verdict
+    # weak #2).  The oracle level-loop can't stop mid-level; its own wall
+    # clock is reported so the rate is exact for the work done.
     from raft_tla_tpu.models import oracle as orc
     from raft_tla_tpu.models.invariants import constraint_py
     from raft_tla_tpu.models.pystate import init_state
@@ -77,10 +84,17 @@ def main():
         "vs_baseline": round(rate / base_rate, 2) if base_rate else None,
         "platform": platform,
         "distinct_states": res.distinct,
+        "generated_states": res.generated,
+        "generated_per_sec": round(res.generated / res.wall_seconds, 1)
+        if res.wall_seconds else 0.0,
         "wall_s": round(res.wall_seconds, 2),
+        "budget_s": BENCH_SECONDS,
         "diameter": res.diameter,
+        "levels": res.levels,
         "stop_reason": res.stop_reason,
         "baseline_states_per_sec": round(base_rate, 1),
+        "baseline_distinct": ores.distinct_states,
+        "baseline_wall_s": round(base_wall, 2),
         "baseline_kind": "python-oracle-1core (no TLC/java available)",
     }))
 

@@ -68,7 +68,9 @@ class EngineConfig:
     # threshold; these set the *device-resident* working set.
     queue_capacity: Optional[int] = 1 << 16
     seen_capacity: Optional[int] = 1 << 18
-    check_deadlock: bool = True
+    # None = defer to the cfg file (make_engine fills it in); a bool from
+    # the caller always wins — the documented precedence chain.
+    check_deadlock: Optional[bool] = None
     record_trace: bool = True
     sync_every: int = 32         # device batches per host round-trip
     max_seconds: Optional[float] = None   # StopAfter duration budget
@@ -146,7 +148,18 @@ def _auto_capacities(sw: int, batch: int,
     except Exception:
         limit = None
     if limit is None:
-        return 1 << 20, 1 << 22
+        try:
+            is_tpu = jax.devices()[0].platform == "tpu"
+        except Exception:
+            is_tpu = False
+        if is_tpu:
+            # Tunnel backends (axon) report no memory stats; assume a
+            # v5e-class 16 GB HBM rather than collapsing to CPU-test
+            # sizes — an undersized seen-set costs a growth-rehash (and a
+            # chunk recompile) per doubling on big runs.
+            limit = 16 << 30
+        else:
+            return 1 << 20, 1 << 22
     usable = int(limit * 0.75)
     row_cost = 2 * sw + (20 if record_trace else 0)   # queues + trace row
     q = max(batch, min(usable // 2 // row_cost, 1 << 25))
@@ -269,7 +282,7 @@ class BFSEngine:
         # The loop exits early on violation / deadlock / overflow /
         # trace-buffer pressure; the host inspects the packed stats and
         # fetches the few relevant rows only when a flag is set.
-        CH = max(1, cfg.sync_every)
+        CH = self._CH = max(1, cfg.sync_every)
         # Trace-buffer rows: enough that a fresh chunk (tcount=0) always
         # has room for >= 1 batch, else the loop could make no progress.
         # With tracing off the buffers shrink to stubs and every trace
@@ -277,7 +290,10 @@ class BFSEngine:
         # raw-throughput runs pay nothing for the feature.
         record_static = cfg.record_trace
         TQ = Q + B * G if record_static else 8
-        check_deadlock_static = cfg.check_deadlock
+        # None (config default) = TLC's default: deadlock checking on.
+        self._check_deadlock = (True if cfg.check_deadlock is None
+                                else cfg.check_deadlock)
+        check_deadlock_static = self._check_deadlock
         # The next-level queue must always have room for one worst-case
         # batch (every instance of every state new): the device loop stops
         # at this watermark and the host spills the queue to its memory
@@ -360,7 +376,11 @@ class BFSEngine:
                     fail_any | fail)
 
         def chunk(qcur, cur_count, offset0, qnext, next_count, seen,
-                  tbuf, tcount0):
+                  tbuf, tcount0, max_steps):
+            # ``max_steps`` (<= CH) is a runtime argument: near a duration
+            # budget the host shrinks it so the deadline is honored to
+            # within ~one batch, not one whole chunk (TLCGet("duration")
+            # promptness — Smokeraft.tla:90).
             init = (offset0, jnp.int32(0), qnext, next_count, seen, tbuf,
                     tcount0, jnp.int32(0), jnp.int32(0), jnp.int32(0),
                     jnp.bool_(False), jnp.zeros((sw,), jnp.uint8),
@@ -372,7 +392,7 @@ class BFSEngine:
                 (offset, steps, _qn, next_count, seen_c, _tb, tcount,
                  _g, _n, ovfc, dead_any, _dr, viol_any, _vi, _vr, _vh,
                  _vl, fail_any) = c
-                more = (offset < cur_count) & (steps < CH)
+                more = (offset < cur_count) & (steps < max_steps)
                 qroom = next_count <= QTH       # host spills past this
                 # Stop for growth at half-full: the host doubles the table
                 # before the load can reach probe-failure territory.  A
@@ -481,9 +501,11 @@ class BFSEngine:
                            qnext, next_count, seen)
         qnext, next_count, seen = out[0], out[1], out[2]
         out = self._chunk(qcur, jnp.int32(0), jnp.int32(0),
-                          qnext, next_count, seen, tbuf, jnp.int32(0))
+                          qnext, next_count, seen, tbuf, jnp.int32(0),
+                          jnp.int32(self._CH))
         qnext, seen, tbuf = out[0], out[1], out[2]
         t0 = time.time()
+        self._batch_ema = 0.0   # measured seconds per device batch
 
         if resume is not None:
             # Restore the level-boundary image: re-insert the saved keys
@@ -597,12 +619,30 @@ class BFSEngine:
             while True:
                 offset = 0
                 while offset < cur_count:
+                    # Duration-budget promptness: size this chunk call (in
+                    # batches) from the measured per-batch cost so the run
+                    # stops within ~one batch of the deadline, not one
+                    # whole sync_every chunk past it.
+                    allowed = self._CH
+                    if cfg.max_seconds is not None:
+                        remaining = cfg.max_seconds - (time.time() - t0)
+                        if remaining <= 0:
+                            res.stop_reason = "duration_budget"
+                            break
+                        if self._batch_ema:
+                            allowed = max(1, min(
+                                self._CH, int(remaining / self._batch_ema)))
+                    t_call = time.time()
                     out = self._chunk(qcur, jnp.int32(cur_count),
                                       jnp.int32(offset), qnext,
                                       jnp.int32(next_count_h), seen, tbuf,
-                                      jnp.int32(0))
+                                      jnp.int32(0), jnp.int32(allowed))
                     qnext, seen, tbuf = out[0], out[1], out[2]
                     st = np.asarray(out[3])
+                    if int(st[1]):       # st fetch synced: timing is real
+                        per = (time.time() - t_call) / int(st[1])
+                        self._batch_ema = (per if not self._batch_ema else
+                                           0.5 * self._batch_ema + 0.5 * per)
                     offset, next_count_h = int(st[0]), int(st[2])
                     seen_size, tcount = int(st[3]), int(st[4])
                     n_gen, n_new, n_ovf = int(st[5]), int(st[6]), int(st[7])
@@ -641,14 +681,10 @@ class BFSEngine:
                             fingerprint=(int(vhl[0]) << 32) | int(vhl[1]))
                         res.stop_reason = "violation"
                         break
-                    if dead_any and cfg.check_deadlock:
+                    if dead_any and self._check_deadlock:
                         res.deadlock = decode_state(
                             unflatten_state(np.asarray(out[4]), dims), dims)
                         res.stop_reason = "deadlock"
-                        break
-                    if (cfg.max_seconds is not None
-                            and time.time() - t0 > cfg.max_seconds):
-                        res.stop_reason = "duration_budget"
                         break
                 if res.stop_reason != "exhausted" \
                         or res.violation is not None or not pending:
@@ -669,6 +705,10 @@ class BFSEngine:
             pending, spill_next = spill_next, []
 
         res.wall_seconds = time.time() - t0
+        # Final frontier snapshot (empty when exhausted): profiling tools
+        # use it as a representative mid-level workload.
+        self._last_frontier = (np.asarray(qcur[:cur_count]) if cur_count
+                               else np.zeros((0, sw), ROW_DTYPE))
         return res
 
     # ------------------------------------------------------------------

@@ -81,7 +81,9 @@ def make_engine(setup: CheckSetup,
     base = engine_config or engine_config_from_backend(setup)
     cfg = _dc.replace(          # never mutate the caller's config
         base,
-        check_deadlock=setup.check_deadlock,
+        check_deadlock=(base.check_deadlock
+                        if base.check_deadlock is not None
+                        else setup.check_deadlock),
         max_seconds=(base.max_seconds if base.max_seconds is not None
                      else setup.max_seconds),
         max_diameter=(base.max_diameter if base.max_diameter is not None

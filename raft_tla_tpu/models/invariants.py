@@ -97,6 +97,12 @@ class Bounds:
     max_term: Optional[int] = None       # \A i : currentTerm[i] <= MaxTerm
     max_log_len: Optional[int] = None    # \A i : Len(log[i]) <= MaxLogLen
     max_msg_count: Optional[int] = None  # \A m : messages[m] <= MaxDup
+    # Cardinality(DOMAIN messages) <= MaxInFlight: bounds the number of
+    # DISTINCT in-flight messages.  Without it the bag domain is the
+    # dominant growth axis (the MCraft_bounded space passes 63M states by
+    # level 13, BASELINE.md §b); the standard TLC recipe bounds it with
+    # exactly this kind of state constraint.
+    max_in_flight: Optional[int] = None
 
 
 def build_inv_id(inv_fns):
@@ -123,6 +129,9 @@ def build_constraint(dims: RaftDims, bounds: Bounds):
             ok = ok & jnp.all(st.log_len <= bounds.max_log_len)
         if bounds.max_msg_count is not None:
             ok = ok & jnp.all(st.msg_cnt <= bounds.max_msg_count)
+        if bounds.max_in_flight is not None:
+            ok = ok & (jnp.sum((st.msg_cnt > 0).astype(jnp.int32))
+                       <= bounds.max_in_flight)
         return ok
 
     return constraint
@@ -137,6 +146,8 @@ def constraint_py(bounds: Bounds):
             ok &= max(len(l) for l in s.log) <= bounds.max_log_len
         if bounds.max_msg_count is not None:
             ok &= all(c <= bounds.max_msg_count for _m, c in s.messages)
+        if bounds.max_in_flight is not None:
+            ok &= len(s.messages) <= bounds.max_in_flight
         return ok
 
     return constraint

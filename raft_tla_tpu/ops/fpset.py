@@ -49,6 +49,9 @@ _I32 = jnp.int32
 # around 0.7^32 ≈ 1e-5, and a miss is a *reported error*, never a lost state.
 PROBE_ROUNDS = 32
 
+# Claim-table cap (slots).  32 MB of int32 at 2^23; see insert_unique.
+CLAIM_CAP = 1 << 23
+
 
 class FPSet(NamedTuple):
     hi: jnp.ndarray    # [C] uint32 key lane; SENTINEL pair = empty slot
@@ -78,16 +81,27 @@ def _probe_base(qhi, qlo, c):
     return h1 & _U32(c - 1), h2
 
 
-# TPU gather/scatter performance is shape-sensitive in two ways this module
-# must design around (measured on v5e through the serving tunnel):
+# TPU gather/scatter performance is shape-sensitive in three ways this
+# module must design around (measured on v5e through the serving tunnel):
 # 1. a gather where a large fraction of lanes reads the SAME address (e.g.
 #    every invalid query probing the sentinel key's slot) serializes on the
 #    hot address — 0.05ms becomes 300ms;
 # 2. non-power-of-two query batches hit a slow lowering (270336 lanes is
-#    4000x slower than 262144 for the identical gather).
+#    4000x slower than 262144 for the identical gather);
+# 3. the same hot-address serialization applies to SCATTERS — including
+#    lanes "masked off" by routing them to one shared out-of-range index
+#    with mode="drop".  A scatter with half a million lanes on one
+#    (dropped!) index costs ~400ms; four of them made one insert cost
+#    1.7 s/batch in round 2.  Masked scatters must therefore be
+#    *value-neutral*, not address-neutral: every lane writes to its own
+#    (hash-random) address, and inactive lanes contribute the operation's
+#    identity element (-1 for the claim's max, SENTINEL for the key
+#    table's min) so the write is a no-op wherever it lands.
 # Hence: every probing entry point pads its query batch to a power of two,
-# and inactive lanes probe a per-lane spread address instead of a shared
-# one.  Both transformations are semantically invisible.
+# inactive lanes GATHER from a per-lane spread address instead of a shared
+# one, and every scatter is an identity-element combiner (max/min), never
+# a .set behind a shared drop index.  All transformations are semantically
+# invisible.
 
 def _pow2(n: int) -> int:
     p = 1
@@ -145,23 +159,45 @@ def insert_unique(s: FPSet, qhi, qlo, valid) -> Tuple["FPSet", jnp.ndarray,
     spread = (arange & (c - 1)).astype(_I32)   # cold per-lane addresses
     pending = valid
     is_new = jnp.zeros((kp,), bool)
-    claim = jnp.full((c,), -1, _I32)
+    # The claim table may be smaller than the key table (capped: a 2^28
+    # table would need a 1 GB int32 claim).  Two lanes attempting
+    # *different* slots that alias in the claim table just means one loses
+    # and retries its chain next round — correctness is unaffected, and at
+    # 2^23 entries the alias probability per round is ~kp/2^23.
+    cm = min(c, CLAIM_CAP) - 1
+    # Claim values are round-tagged (r*kp + lane) so a round-r attempt
+    # always supersedes any stale entry from an earlier round under the
+    # max combiner — no reset scatter, and a claim-cap alias can never
+    # eclipse a later round's attempt.  Tags must fit int32:
+    assert (PROBE_ROUNDS + 1) * kp < 2**31, "claim tag overflow"
+    claim = jnp.full((cm + 1,), -1, _I32)
+    # Per-lane probe position.  A lane advances its chain ONLY after
+    # observing its current slot occupied by a different key; on a claim
+    # loss it retries the same slot next round (the winner's write is
+    # visible by then).  This preserves the chain invariant every probing
+    # reader depends on — the first empty slot of a key's chain terminates
+    # the search — even when a claim-cap alias makes a lane lose a claim
+    # on a slot that then stays empty.
+    step = jnp.zeros((kp,), _U32)
     for r in range(PROBE_ROUNDS):
-        probe = ((h1 + _U32(r) * h2) & _U32(c - 1)).astype(_I32)
+        probe = ((h1 + step * h2) & _U32(c - 1)).astype(_I32)
         idx = jnp.where(pending, probe, spread)
         cur_hi, cur_lo = hi[idx], lo[idx]
         match = pending & (cur_hi == qhi) & (cur_lo == qlo)
         pending = pending & ~match
-        attempt = pending & (cur_hi == SENTINEL) & (cur_lo == SENTINEL)
-        a_idx = jnp.where(attempt, idx, c)
-        claim = claim.at[a_idx].max(arange, mode="drop")
-        win = attempt & (claim[idx] == arange)
-        w_idx = jnp.where(win, idx, c)
-        hi = hi.at[w_idx].set(qhi, mode="drop")
-        lo = lo.at[w_idx].set(qlo, mode="drop")
+        occupied = pending & ~((cur_hi == SENTINEL) & (cur_lo == SENTINEL))
+        attempt = pending & ~occupied
+        # Every scatter below writes to idx (hash-random, no hot address);
+        # inactive lanes write the combiner's identity element instead of
+        # being routed to a shared drop index (design note 3 above).
+        tag = _I32(r * kp) + arange
+        claim = claim.at[idx & cm].max(jnp.where(attempt, tag, -1))
+        win = attempt & (claim[idx & cm] == tag)
+        hi = hi.at[idx].min(jnp.where(win, qhi, SENTINEL))
+        lo = lo.at[idx].min(jnp.where(win, qlo, SENTINEL))
         is_new = is_new | win
         pending = pending & ~win
-        claim = claim.at[a_idx].set(-1, mode="drop")   # reset touched slots
+        step = step + occupied.astype(_U32)
     return (FPSet(hi=hi, lo=lo,
                   size=s.size + jnp.sum(is_new, dtype=_I32)),
             is_new[:k], jnp.any(pending))

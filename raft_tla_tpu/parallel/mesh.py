@@ -17,9 +17,24 @@ the program is identical):
   8-byte fingerprints ever cross the interconnect, never state rows;
 - stats (new/generated/overflow/deadlock/violation) combine with ``psum``.
 
-The host loop mirrors engine/bfs.py: offsets advance in lockstep batches
-(chips with short local queues mask out), queues swap per level, scalars and
-compacted trace records stream back per step.
+Runtime parity with the single-chip engine (engine/bfs.py):
+
+- **device-resident chunk loop**: up to ``sync_every`` batches run per host
+  round-trip inside a ``lax.while_loop`` whose continue condition is a
+  replicated psum-reduction (all chips iterate in lockstep — a collective
+  inside the body requires every chip to take the same trip count);
+- **host spill**: when any chip's next-level queue passes its watermark the
+  chunk exits and the host drains ALL chips' queues into one host pool
+  (TLC's disk queue); pool segments re-upload *balanced* across chips, so
+  spill doubles as load rebalancing;
+- **seen-set growth**: when any shard passes half load the host pulls its
+  keys and rebuilds every shard at double capacity (owner = fp mod n is
+  unchanged, so keys stay on their chips);
+- **checkpoint/resume**: level-boundary snapshots in the SAME format as the
+  single-chip engine (frontier rows + flat key set) — a run checkpointed on
+  the mesh can resume single-chip and vice versa; the key→owner and
+  frontier layouts are recomputed on load, so even the device count may
+  change across a resume.
 
 Tested on a virtual 8-device CPU mesh (SURVEY §4.5); the program is
 identical on a real TPU slice.
@@ -34,7 +49,7 @@ from typing import Callable, Dict, List, Optional
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax.sharding import Mesh, PartitionSpec as P
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..engine.bfs import (EngineConfig, EngineResult, TraceStore, Violation,
                           build_root_check, find_root_violation,
@@ -68,31 +83,43 @@ class MeshBFSEngine:
         self.n_dev = n = len(devices)
         self.mesh = Mesh(np.asarray(devices), ("x",))
         self.inv_names = list((invariants or {}).keys())
-        inv_fns = list((invariants or {}).values())
+        self._inv_fns = inv_fns = list((invariants or {}).values())
+        self._constraint = constraint
         expand = build_expand(dims)
         fingerprint = build_fingerprint(dims)
         pack_ok = build_pack_guard(dims)
         sw = state_width(dims)
         B, G = cfg.batch, dims.n_instances
         K = B * G
-        # Per-chip capacities.  None resolves through the same HBM
-        # auto-sizing as the single-chip engine (per-chip budget); unlike
-        # it, the mesh engine does not yet spill or grow — overflow is a
-        # hard error here until the spill path lands in this engine too.
+        self._check_deadlock = (True if cfg.check_deadlock is None
+                                else cfg.check_deadlock)
+        # Per-chip capacities; None resolves through the same HBM
+        # auto-sizing as the single-chip engine (per-chip budget).
         from ..engine.bfs import _auto_capacities
         qreq, sreq = cfg.queue_capacity, cfg.seen_capacity
         if qreq is None or sreq is None:
             auto_q, auto_s = _auto_capacities(sw, B, cfg.record_trace)
             qreq = auto_q if qreq is None else qreq
             sreq = auto_s if sreq is None else sreq
+        # Queue: batch-multiple, floored at one worst-case batch (B*G new
+        # rows) — a batch can never overflow mid-chunk; the watermark
+        # below spills *between* batches (engine/bfs.py invariant).
         per_chip = -(-qreq // n)
-        QL = max(B, -(-per_chip // B) * B)   # round up to a batch multiple
-        # Per-chip hash-table shard: power of two for masked probing.
-        CL = fpset._capacity(-(-sreq // n))
-        self._sw, self._B, self._QL, self._CL = sw, B, QL, CL
+        QL = max(-(-per_chip // B) * B, K)
+        # Seen shard: each chip receives up to B*G owner-routed queries per
+        # batch; the same 8-batch floor as the single-chip engine keeps the
+        # growth threshold (half load) safely ahead of probe failure.
+        CL = fpset._capacity(max(-(-sreq // n), 8 * K))
+        self._sw, self._B, self._G, self._QL, self._CL = sw, B, G, QL, CL
+        self._QTH = QL - K
+        CH = self._CH = max(1, cfg.sync_every)
+        record_static = cfg.record_trace
+        TQ = QL + K if record_static else 8
+        self._TQ = TQ
+        check_deadlock_static = self._check_deadlock
 
         def local_absorb(crows, cands, en, parent_hi, parent_lo, actions,
-                         qnext, next_count, shi, slo, ssize):
+                         qnext, next_count, seen_local, tbuf, tcount):
             """Per-chip tail with cross-chip owner dedup.  All arrays are
             this chip's shard (no leading device axis)."""
             k = crows.shape[0]
@@ -118,7 +145,6 @@ class MeshBFSEngine:
             # the novelty bit.
             rh, rl = bh.reshape(-1), bl.reshape(-1)
             rvalid = ~((rh == SENTINEL) & (rl == SENTINEL))
-            seen_local = fpset.FPSet(hi=shi, lo=slo, size=ssize)
             seen_local, qnew, fail = fpset.insert(seen_local, rh, rl, rvalid)
             nov = jax.lax.all_to_all(qnew.reshape(n, k), "x", 0, 0,
                                      tiled=True)
@@ -146,89 +172,149 @@ class MeshBFSEngine:
             qnext = qnext.at[pos].set(crows, mode="drop")
             next_count = next_count + jnp.sum(enq, dtype=_I32)
 
-            tpos = jnp.where(new, jnp.cumsum(new.astype(_I32)) - 1, k)
+            if record_static:
+                tpos = jnp.where(
+                    new, tcount + jnp.cumsum(new.astype(_I32)) - 1, TQ)
+                tbuf = tuple(
+                    buf.at[tpos].set(col, mode="drop")
+                    for buf, col in zip(
+                        tbuf, (fph, fpl, parent_hi, parent_lo, actions)))
+                tcount = tcount + n_new
 
-            def compact(x):
-                return jnp.zeros((k,), x.dtype).at[tpos].set(x, mode="drop")
-
-            tr = (compact(fph), compact(fpl), compact(parent_hi),
-                  compact(parent_lo), compact(actions))
             vinfo = (viol_any, inv[vpos], crows[vpos], fph[vpos], fpl[vpos])
-            return (qnext, next_count, seen_local.hi, seen_local.lo,
-                    seen_local.size, n_new, fail, tr, vinfo)
+            return (qnext, next_count, seen_local, tbuf, tcount, n_new,
+                    fail, vinfo)
 
-        def sharded_step(qcur, cur_count, offset, qnext, next_count,
-                         shi, slo, ssize):
-            # Shapes inside shard_map: qcur [1,QL,SW], counts [1], etc.
-            qcur_l, qnext_l = qcur[0], qnext[0]
-            cnt_l, ncnt_l = cur_count[0], next_count[0]
-            shi_l, slo_l, ssz_l = shi[0], slo[0], ssize[0]
+        def chunk_body(qcur_l, cur_count_l, carry):
+            (offset, steps, qnext_l, ncnt_l, seen_l, tbuf_l, tcnt_l,
+             gen, newc, ovfc, dead_any, drow, viol_any, vinv, vrow,
+             vhi, vlo, fail_any) = carry
             rows = jax.lax.dynamic_slice_in_dim(qcur_l, offset, B, axis=0)
-            valid = (offset + jnp.arange(B, dtype=_I32)) < cnt_l
+            valid = (offset + jnp.arange(B, dtype=_I32)) < cur_count_l
             states = jax.vmap(unflatten_state, (0, None))(rows, dims)
             cands, en, ovf = jax.vmap(expand)(states)
             en = en & valid[:, None]
-            # uint8-row wrap guard (schema.build_pack_guard): hard overflow.
             ovf = (ovf | (en & ~jax.vmap(jax.vmap(pack_ok))(cands))) \
                 & valid[:, None]
-            dead = valid & ~jnp.any(en, axis=1) & ~jnp.any(ovf, axis=1)
-            dead_any = jnp.any(dead)
-            drow = rows[jnp.argmax(dead)]
+            dead_b = valid & ~jnp.any(en, axis=1) & ~jnp.any(ovf, axis=1)
+            dead_any_b = jnp.any(dead_b)
+            drow_b = rows[jnp.argmax(dead_b)]
 
             cflat = jax.tree.map(
                 lambda a: a.reshape((K,) + a.shape[2:]), cands)
             crows = jax.vmap(flatten_state, (0, None))(cflat, dims)
-            php, plp = jax.vmap(fingerprint)(states)
-            k_idx = jnp.arange(K, dtype=_I32)
-            (qnext_l, ncnt_l, shi_l, slo_l, ssz_l, n_new, fail, tr,
+            if record_static:
+                php, plp = jax.vmap(fingerprint)(states)
+                k_idx = jnp.arange(K, dtype=_I32)
+                parent_hi, parent_lo = php[k_idx // G], plp[k_idx // G]
+                actions = k_idx % G
+            else:
+                parent_hi = parent_lo = jnp.zeros((K,), _U32)
+                actions = jnp.full((K,), -1, _I32)
+            (qnext_l, ncnt_l, seen_l, tbuf_l, tcnt_l, n_new, fail,
              vinfo) = local_absorb(
-                crows, cflat, en.reshape(-1), php[k_idx // G],
-                plp[k_idx // G], k_idx % G, qnext_l, ncnt_l,
-                shi_l, slo_l, ssz_l)
-            g_new = jax.lax.psum(n_new, "x")
-            g_gen = jax.lax.psum(jnp.sum(en, dtype=_I32), "x")
-            g_ovf = jax.lax.psum(jnp.sum(ovf, dtype=_I32), "x")
-            g_fail = jax.lax.psum(fail.astype(_I32), "x")
-            stats = (g_new[None], g_gen[None], g_ovf[None], dead_any[None],
-                     g_fail[None])
-            return (qnext_l[None], ncnt_l[None], shi_l[None], slo_l[None],
-                    ssz_l[None], stats,
-                    tuple(x[None] for x in tr),
-                    tuple(jnp.asarray(x)[None] for x in vinfo),
-                    drow[None], n_new[None])
+                crows, cflat, en.reshape(-1), parent_hi, parent_lo,
+                actions, qnext_l, ncnt_l, seen_l, tbuf_l, tcnt_l)
+            viol_any_b, inv_b, vrow_b, vhi_b, vlo_b = vinfo
+            take_v = ~viol_any & viol_any_b
+            vinv = jnp.where(take_v, inv_b, vinv)
+            vrow = jnp.where(take_v, vrow_b, vrow)
+            vhi = jnp.where(take_v, vhi_b, vhi)
+            vlo = jnp.where(take_v, vlo_b, vlo)
+            drow = jnp.where(dead_any | ~dead_any_b, drow, drow_b)
+            return (offset + B, steps + 1, qnext_l, ncnt_l, seen_l, tbuf_l,
+                    tcnt_l, gen + jnp.sum(en, dtype=_I32), newc + n_new,
+                    ovfc + jnp.sum(ovf, dtype=_I32),
+                    dead_any | dead_any_b, drow,
+                    viol_any | viol_any_b, vinv, vrow, vhi, vlo,
+                    fail_any | fail)
 
-        def sharded_ingest(rows, valid, qnext, next_count, shi, slo, ssize):
+        def sharded_chunk(qcur, cur_counts, offset0, qnext, next_counts,
+                          shi, slo, ssize, tbuf, tcount0, max_steps,
+                          max_count):
+            # Shapes inside shard_map: leading device axis of size 1.
+            qcur_l, qnext_l = qcur[0], qnext[0]
+            cnt_l, ncnt_l = cur_counts[0], next_counts[0]
+            seen_l = fpset.FPSet(hi=shi[0], lo=slo[0], size=ssize[0])
+            tbuf_l = tuple(t[0] for t in tbuf)
+            init = (offset0, jnp.int32(0), qnext_l, ncnt_l, seen_l, tbuf_l,
+                    tcount0[0], jnp.int32(0), jnp.int32(0), jnp.int32(0),
+                    jnp.bool_(False), jnp.zeros((sw,), jnp.uint8),
+                    jnp.bool_(False), jnp.int32(-1),
+                    jnp.zeros((sw,), jnp.uint8),
+                    jnp.uint32(0), jnp.uint32(0), jnp.bool_(False))
+
+            def cond(c):
+                (offset, steps, _qn, ncnt_c, seen_c, _tb, tcnt_c,
+                 _g, _n, ovfc, dead_any, _dr, viol_any, _vi, _vr, _vh,
+                 _vl, fail_any) = c
+                # Every term is reduced to a REPLICATED bool so all chips
+                # take the same trip count (the body contains all_to_all).
+                more = (offset < max_count) & (steps < max_steps)
+                blocked = (ncnt_c > QL - K).astype(_I32) \
+                    + (seen_c.size > CL // 2).astype(_I32)
+                stop = viol_any.astype(_I32) + (ovfc > 0).astype(_I32) \
+                    + fail_any.astype(_I32)
+                if check_deadlock_static:
+                    stop = stop + dead_any.astype(_I32)
+                if record_static:
+                    blocked = blocked + (tcnt_c > TQ - K).astype(_I32)
+                return more & (jax.lax.psum(blocked + stop, "x") == 0)
+
+            out = jax.lax.while_loop(
+                cond, lambda c: chunk_body(qcur_l, cnt_l, c), init)
+            (offset, steps, qnext_l, ncnt_l, seen_l, tbuf_l, tcnt_l,
+             gen, newc, ovfc, dead_any, drow, viol_any, vinv, vrow,
+             vhi, vlo, fail_any) = out
+            g_gen = jax.lax.psum(gen, "x")
+            g_new = jax.lax.psum(newc, "x")
+            g_ovf = jax.lax.psum(ovfc, "x")
+            g_fail = jax.lax.psum(fail_any.astype(_I32), "x")
+            stats = jnp.stack([offset, steps, g_gen, g_new, g_ovf, g_fail])
+            local = jnp.stack([ncnt_l, seen_l.size, tcnt_l,
+                               dead_any.astype(_I32), viol_any.astype(_I32),
+                               vinv])
+            return (qnext_l[None], ncnt_l[None], seen_l.hi[None],
+                    seen_l.lo[None], seen_l.size[None],
+                    tuple(t[None] for t in tbuf_l), tcnt_l[None],
+                    stats[None], local[None], drow[None], vrow[None],
+                    jnp.stack([vhi, vlo])[None])
+
+        def sharded_ingest(rows, valid, qnext, next_counts, shi, slo, ssize,
+                           tbuf, tcount0):
             rows_l, valid_l = rows[0], valid[0]
             states = jax.vmap(unflatten_state, (0, None))(rows_l, dims)
             sent = jnp.zeros(rows_l.shape[:1], _U32)
             acts = jnp.full(rows_l.shape[:1], -1, _I32)
-            (qnext_l, ncnt_l, shi_l, slo_l, ssz_l, n_new, fail, tr,
+            seen_l = fpset.FPSet(hi=shi[0], lo=slo[0], size=ssize[0])
+            tbuf_l = tuple(t[0] for t in tbuf)
+            (qnext_l, ncnt_l, seen_l, tbuf_l, tcnt_l, n_new, fail,
              vinfo) = local_absorb(
                 rows_l, states, valid_l, sent, sent, acts,
-                qnext[0], next_count[0], shi[0], slo[0], ssize[0])
+                qnext[0], next_counts[0], seen_l, tbuf_l, tcount0[0])
             g_new = jax.lax.psum(n_new, "x")
             g_fail = jax.lax.psum(fail.astype(_I32), "x")
-            return (qnext_l[None], ncnt_l[None], shi_l[None], slo_l[None],
-                    ssz_l[None], g_new[None], g_fail[None],
-                    tuple(x[None] for x in tr),
-                    tuple(jnp.asarray(x)[None] for x in vinfo),
-                    n_new[None])
+            return (qnext_l[None], ncnt_l[None], seen_l.hi[None],
+                    seen_l.lo[None], seen_l.size[None],
+                    tuple(t[None] for t in tbuf_l), tcnt_l[None],
+                    g_new[None], g_fail[None],
+                    tuple(jnp.asarray(x)[None] for x in vinfo))
 
         shard = partial(jax.shard_map, mesh=self.mesh, check_vma=False)
         sx = P("x")
         rep = P()
-        self._step = jax.jit(shard(
-            sharded_step,
-            in_specs=(sx, sx, rep, sx, sx, sx, sx, sx),
-            out_specs=(sx, sx, sx, sx, sx,
-                       (sx, sx, sx, sx, sx), (sx,) * 5, (sx,) * 5, sx, sx)),
-            donate_argnums=(3, 5, 6))
+        self._chunk = jax.jit(shard(
+            sharded_chunk,
+            in_specs=(sx, sx, rep, sx, sx, sx, sx, sx, sx, sx, rep, rep),
+            out_specs=(sx, sx, sx, sx, sx, (sx,) * 5, sx, sx, sx, sx, sx,
+                       sx)),
+            donate_argnums=(3, 5, 6, 7, 8))
         self._ingest = jax.jit(shard(
             sharded_ingest,
-            in_specs=(sx, sx, sx, sx, sx, sx, sx),
-            out_specs=(sx, sx, sx, sx, sx, sx, sx,
-                       (sx,) * 5, (sx,) * 5, sx)),
-            donate_argnums=(2, 4, 5))
+            in_specs=(sx, sx, sx, sx, sx, sx, sx, sx, sx),
+            out_specs=(sx, sx, sx, sx, sx, (sx,) * 5, sx, sx, sx,
+                       (sx,) * 5)),
+            donate_argnums=(2, 4, 5, 6, 7))
 
         def fp_rows(rows):
             return jax.vmap(fingerprint)(
@@ -241,143 +327,366 @@ class MeshBFSEngine:
                             if inv_fns else None)
 
     # ------------------------------------------------------------------
-    def run(self, init_states: List[PyState]) -> EngineResult:
+    def _empty_tbuf(self):
+        n, TQ = self.n_dev, self._TQ
+        return (jnp.zeros((n, TQ), jnp.uint32), jnp.zeros((n, TQ), jnp.uint32),
+                jnp.zeros((n, TQ), jnp.uint32), jnp.zeros((n, TQ), jnp.uint32),
+                jnp.zeros((n, TQ), _I32))
+
+    def _grow_seen(self, shi, slo, ssize, new_cl=None):
+        """Rebuild every shard at double (or given) capacity.  Owner
+        assignment (fp_hi mod n) is capacity-independent, so keys stay on
+        their chips; the chunk program recompiles for the new shape."""
+        n = self.n_dev
+        new_cl = new_cl or 2 * self._CL
+        hi_h, lo_h = np.asarray(shi), np.asarray(slo)
+        shards = []
+        for d in range(n):
+            real = ~((hi_h[d] == SENTINEL) & (lo_h[d] == SENTINEL))
+            shards.append(fpset.from_host_keys(
+                hi_h[d][real], lo_h[d][real], new_cl))
+        self._CL = fpset._capacity(new_cl)
+        self._rebuild_programs()
+        return (jnp.stack([s.hi for s in shards]),
+                jnp.stack([s.lo for s in shards]),
+                jnp.stack([s.size for s in shards]))
+
+    def _rebuild_programs(self):
+        """Re-trace chunk/ingest for a changed seen-shard shape."""
+        MeshBFSEngine.__init__(
+            self, self.dims,
+            invariants=dict(zip(self.inv_names, self._inv_fns)),
+            constraint=self._constraint,
+            config=self._cfg_with_seen(self._CL * self.n_dev),
+            devices=list(self.mesh.devices.ravel()))
+
+    def _cfg_with_seen(self, total):
+        import dataclasses as _dc
+        return _dc.replace(self.config, seen_capacity=total)
+
+    # ------------------------------------------------------------------
+    def run(self, init_states: Optional[List[PyState]] = None,
+            resume=None) -> EngineResult:
+        from ..engine import checkpoint as ckpt_mod
         dims, cfg = self.dims, self.config
-        n, sw, B, QL, CL = self.n_dev, self._sw, self._B, self._QL, self._CL
+        n, sw, B, QL = self.n_dev, self._sw, self._B, self._QL
+        if resume is not None and isinstance(resume, str):
+            resume = ckpt_mod.load(resume)
+        if resume is not None and resume.dims != dims:
+            raise ValueError(
+                f"checkpoint dims {resume.dims} != engine dims {dims}")
+        if resume is None and init_states is None:
+            raise ValueError("need init_states or resume")
         res = EngineResult()
-        t_enter = time.time()   # for early returns before the budget clock
+        t_enter = time.time()
         trace = make_trace_store() if cfg.record_trace else TraceStore()
         self.trace = trace
 
+        if resume is not None:
+            # Shards must hold the checkpointed keys at <= half load.
+            per_owner = np.asarray(resume.seen_hi, np.uint64) % n
+            max_keys = max((int((per_owner == d).sum()) for d in range(n)),
+                           default=0)
+            while max_keys > self._CL // 2:
+                self._CL *= 2
+                self._rebuild_programs()
+
+        CL = self._CL
         qcur = jnp.zeros((n, QL, sw), jnp.uint8)
         qnext = jnp.zeros((n, QL, sw), jnp.uint8)
         shi = jnp.full((n, CL), SENTINEL, _U32)
         slo = jnp.full((n, CL), SENTINEL, _U32)
         ssize = jnp.zeros((n,), _I32)
         next_counts = jnp.zeros((n,), _I32)
+        tbuf = self._empty_tbuf()
+        tcount = jnp.zeros((n,), _I32)
+        pending: List[np.ndarray] = []   # host pool (rows), global
+        spill_next: List[np.ndarray] = []
 
-        encoded = [encode_state(s, dims) for s in init_states]
-        # Pre-pack invariant check (engine/bfs.py build_root_check).
-        if self._root_check is not None:
-            v = find_root_violation(self._root_check, encoded, init_states,
-                                    B, self.inv_names)
-            if v is not None:     # before warm-up: no checking time elapsed
-                res.violation = v
-                res.stop_reason = "violation"
-                res.levels.append(0)
-                res.wall_seconds = time.time() - t_enter
-                return res
-        for e in encoded:         # reject silently-aliasing roots
-            check_packable(e)
-        rows_np = np.stack([flatten_state(e, dims) for e in encoded])
-        if cfg.record_trace:
-            rhi, rlo = (np.asarray(x) for x in
-                        self._fp_rows(jnp.asarray(rows_np)))
-            for idx, s in enumerate(init_states):
-                trace.roots.setdefault(
-                    (int(rhi[idx]) << 32) | int(rlo[idx]), s)
+        if resume is None:
+            encoded = [encode_state(s, dims) for s in init_states]
+            if self._root_check is not None:
+                v = find_root_violation(self._root_check, encoded,
+                                        init_states, B, self.inv_names)
+                if v is not None:   # before warm-up: no checking time spent
+                    res.violation = v
+                    res.stop_reason = "violation"
+                    res.levels.append(0)
+                    res.wall_seconds = time.time() - t_enter
+                    return res
+            for e in encoded:       # reject silently-aliasing roots
+                check_packable(e)
+            rows_np = np.stack([flatten_state(e, dims) for e in encoded])
+            if cfg.record_trace:
+                rhi, rlo = (np.asarray(x) for x in
+                            self._fp_rows(jnp.asarray(rows_np)))
+                for idx, s in enumerate(init_states):
+                    trace.roots.setdefault(
+                        (int(rhi[idx]) << 32) | int(rlo[idx]), s)
 
         # Warm-up compilation before the duration clock starts.
         out = self._ingest(jnp.zeros((n, B, sw), jnp.uint8),
                            jnp.zeros((n, B), bool),
-                           qnext, next_counts, shi, slo, ssize)
-        qnext, next_counts, shi, slo, ssize = out[:5]
-        out = self._step(qcur, jnp.zeros((n,), _I32), jnp.int32(0),
-                         qnext, next_counts, shi, slo, ssize)
-        qnext, next_counts, shi, slo, ssize = out[:5]
+                           qnext, next_counts, shi, slo, ssize, tbuf,
+                           tcount)
+        qnext, next_counts, shi, slo, ssize, tbuf = out[:6]
+        out = self._chunk(qcur, jnp.zeros((n,), _I32), jnp.int32(0),
+                          qnext, next_counts, shi, slo, ssize, tbuf,
+                          tcount, jnp.int32(self._CH), jnp.int32(0))
+        qnext, next_counts, shi, slo, ssize, tbuf = out[:6]
         t0 = time.time()
+        self._batch_ema = 0.0
 
-        # Ingest roots round-robin across chips in B-sized waves.
-        per_chip = [rows_np[i::n] for i in range(n)]
-        max_chunks = max((-(-len(p) // B) for p in per_chip), default=0)
-        for c in range(max_chunks):
-            wave = np.zeros((n, B, sw), ROW_DTYPE)
-            valid = np.zeros((n, B), bool)
-            for d in range(n):
-                part = per_chip[d][c * B:(c + 1) * B]
-                wave[d, :len(part)] = part
-                valid[d, :len(part)] = True
-            out = self._ingest(jnp.asarray(wave), jnp.asarray(valid),
-                               qnext, next_counts, shi, slo, ssize)
-            (qnext, next_counts, shi, slo, ssize, g_new, g_fail, tr, vinfo,
-             l_new) = out
-            res.distinct += int(np.asarray(g_new)[0])
-            self._record(trace, tr, np.asarray(l_new))
-            self._capacity_check(next_counts, ssize,
-                                 int(np.asarray(g_fail)[0]))
-            if self._check_violation(res, vinfo):
-                break
+        if resume is not None:
+            # Rebuild shards from the flat key set: owner = fp_hi mod n.
+            keys_hi = np.asarray(resume.seen_hi, np.uint64)
+            keys_lo = np.asarray(resume.seen_lo, np.uint64)
+            owner = (keys_hi % n).astype(np.int64)
+            shards = [fpset.from_host_keys(
+                keys_hi[owner == d].astype(np.uint32),
+                keys_lo[owner == d].astype(np.uint32), self._CL)
+                for d in range(n)]
+            shi = jnp.stack([s.hi for s in shards])
+            slo = jnp.stack([s.lo for s in shards])
+            ssize = jnp.stack([s.size for s in shards])
+            fr = np.ascontiguousarray(resume.frontier).astype(
+                ROW_DTYPE, casting="safe")
+            pending = [fr]
+            cur_counts = np.zeros((n,), np.int64)
+            res.distinct = resume.distinct
+            res.generated = resume.generated
+            res.diameter = resume.diameter
+            res.levels = list(resume.levels)
+            t0 -= resume.wall_seconds
+            if cfg.record_trace:
+                if resume.distinct > 0 and resume.trace_fps.size == 0:
+                    raise ValueError(
+                        "checkpoint was written with trace recording "
+                        "disabled; resume with record_trace=False or "
+                        "restart from scratch")
+                trace.add_batch(resume.trace_fps, resume.trace_parents,
+                                resume.trace_actions)
+                trace.roots.update(resume.roots)
+            elif resume.trace_fps.size > 0 and cfg.checkpoint_dir is not None:
+                raise ValueError(
+                    "resuming a trace-carrying checkpoint with trace "
+                    "recording disabled would write trace-less snapshots "
+                    "into the same directory, shadowing the intact ones "
+                    "for any later trace-on resume; use a different "
+                    "checkpoint_dir or keep tracing enabled")
+        else:
+            # Ingest roots round-robin across chips in B-sized waves.
+            per_chip = [rows_np[i::n] for i in range(n)]
+            max_chunks = max((-(-len(p) // B) for p in per_chip), default=0)
+            for c in range(max_chunks):
+                wave = np.zeros((n, B, sw), ROW_DTYPE)
+                valid = np.zeros((n, B), bool)
+                for d in range(n):
+                    part = per_chip[d][c * B:(c + 1) * B]
+                    wave[d, :len(part)] = part
+                    valid[d, :len(part)] = True
+                out = self._ingest(jnp.asarray(wave), jnp.asarray(valid),
+                                   qnext, next_counts, shi, slo, ssize,
+                                   tbuf, tcount)
+                (qnext, next_counts, shi, slo, ssize, tbuf, tcount, g_new,
+                 g_fail, vinfo) = out
+                res.distinct += int(np.asarray(g_new)[0])
+                if int(np.asarray(g_fail)[0]):
+                    raise RuntimeError("seen-set probe failure during "
+                                       "ingest; raise seen_capacity")
+                self._flush_trace(trace, tbuf, tcount)
+                tcount = jnp.zeros((n,), _I32)
+                shi, slo, ssize = self._maybe_grow(shi, slo, ssize)
+                nc = np.asarray(next_counts)
+                if int(nc.max()) > self._QTH:   # ingest adds <= B per wave
+                    spill_next.append(self._drain(qnext, nc))
+                    next_counts = jnp.zeros((n,), _I32)
+                if self._check_violation_ingest(res, vinfo):
+                    break
+            res.levels.append(int(np.asarray(next_counts).sum())
+                              + sum(len(s) for s in spill_next))
+            qcur, qnext = qnext, qcur
+            cur_counts = np.asarray(next_counts).copy()
+            next_counts = jnp.zeros((n,), _I32)
+            pending, spill_next = spill_next, []
 
-        res.levels.append(int(np.asarray(next_counts).sum()))
-        qcur, qnext = qnext, qcur
-        cur_counts = np.asarray(next_counts).copy()
-        next_counts = jnp.zeros((n,), _I32)
-
-        while cur_counts.sum() > 0 and res.violation is None \
-                and res.stop_reason == "exhausted":
+        skip_ckpt_level = resume.diameter if resume is not None else -1
+        last_ckpt = time.time() if resume is not None else float("-inf")
+        while (cur_counts.sum() > 0 or pending) \
+                and res.violation is None and res.stop_reason == "exhausted":
+            if cfg.checkpoint_dir is not None \
+                    and res.diameter % max(1, cfg.checkpoint_every) == 0 \
+                    and res.diameter != skip_ckpt_level \
+                    and (time.time() - last_ckpt
+                         >= cfg.checkpoint_interval_seconds):
+                self._write_checkpoint(qcur, cur_counts, pending, shi, slo,
+                                       res, trace,
+                                       wall=time.time() - t0)
+                last_ckpt = time.time()
             if cfg.max_diameter is not None \
                     and res.diameter >= cfg.max_diameter:
                 res.stop_reason = "diameter_budget"
                 break
-            offset = 0
-            max_count = int(cur_counts.max())
-            while offset < max_count:
-                out = self._step(qcur, jnp.asarray(cur_counts, _I32),
-                                 jnp.int32(offset), qnext, next_counts,
-                                 shi, slo, ssize)
-                (qnext, next_counts, shi, slo, ssize, stats, tr, vinfo,
-                 drow, l_new) = out
-                g_new = int(np.asarray(stats[0])[0])
-                g_gen = int(np.asarray(stats[1])[0])
-                g_ovf = int(np.asarray(stats[2])[0])
-                dead = np.asarray(stats[3])
-                if g_ovf:
-                    raise RuntimeError(
-                        f"{g_ovf} successors exceeded fixed-width capacity "
-                        f"(max_log={dims.max_log}, "
-                        f"n_msg_slots={dims.n_msg_slots})")
-                res.distinct += g_new
-                res.generated += g_gen
-                self._record(trace, tr, np.asarray(l_new))
-                self._capacity_check(next_counts, ssize,
-                                     int(np.asarray(stats[4])[0]))
-                if self._check_violation(res, vinfo):
+            # Level loop over segments: device-resident rows first, then
+            # host-pool segments (balanced re-uploads).
+            while True:
+                offset = 0
+                max_count = int(cur_counts.max()) if len(cur_counts) else 0
+                while offset < max_count:
+                    allowed = self._CH
+                    if cfg.max_seconds is not None:
+                        remaining = cfg.max_seconds - (time.time() - t0)
+                        if remaining <= 0:
+                            res.stop_reason = "duration_budget"
+                            break
+                        if self._batch_ema:
+                            allowed = max(1, min(
+                                self._CH,
+                                int(remaining / self._batch_ema)))
+                    t_call = time.time()
+                    out = self._chunk(
+                        qcur, jnp.asarray(cur_counts, _I32),
+                        jnp.int32(offset), qnext, next_counts, shi, slo,
+                        ssize, tbuf, tcount, jnp.int32(allowed),
+                        jnp.int32(max_count))
+                    (qnext, next_counts, shi, slo, ssize, tbuf, tcount,
+                     stats, local, drow, vrow, vhl) = out
+                    st = np.asarray(stats)[0]
+                    lc = np.asarray(local)
+                    if int(st[1]):
+                        per = (time.time() - t_call) / int(st[1])
+                        self._batch_ema = (
+                            per if not self._batch_ema else
+                            0.5 * self._batch_ema + 0.5 * per)
+                    offset = int(st[0])
+                    res.generated += int(st[2])
+                    res.distinct += int(st[3])
+                    if int(st[4]):
+                        raise RuntimeError(
+                            f"{int(st[4])} successors exceeded fixed-width "
+                            f"capacity (max_log={dims.max_log}, n_msg_slots"
+                            f"={dims.n_msg_slots}) or wrapped the uint8 "
+                            f"row; rerun with larger capacities/bounds")
+                    if int(st[5]):
+                        raise RuntimeError(
+                            "seen-set probe failure (load spiked within "
+                            "one chunk); raise seen_capacity or lower "
+                            "sync_every")
+                    self._flush_trace(trace, tbuf, tcount)
+                    tcount = jnp.zeros((n,), _I32)
+                    shi, slo, ssize = self._maybe_grow(shi, slo, ssize)
+                    ncnt = lc[:, 0]
+                    if int(ncnt.max()) > self._QTH \
+                            and (offset < max_count or pending):
+                        spill_next.append(self._drain(qnext, ncnt))
+                        next_counts = jnp.zeros((n,), _I32)
+                    viol_chips = lc[:, 4]
+                    if viol_chips.any():
+                        d = int(np.argmax(viol_chips))
+                        vh = np.asarray(vhl)[d]
+                        res.violation = Violation(
+                            invariant=self.inv_names[int(lc[d, 5])],
+                            state=decode_state(unflatten_state(
+                                np.asarray(vrow)[d], dims), dims),
+                            fingerprint=(int(vh[0]) << 32) | int(vh[1]))
+                        res.stop_reason = "violation"
+                        break
+                    if lc[:, 3].any() and self._check_deadlock:
+                        d = int(np.argmax(lc[:, 3]))
+                        res.deadlock = decode_state(unflatten_state(
+                            np.asarray(drow)[d], dims), dims)
+                        res.stop_reason = "deadlock"
+                        break
+                if res.stop_reason != "exhausted" \
+                        or res.violation is not None or not pending:
                     break
-                if dead.any() and cfg.check_deadlock:
-                    d = int(np.argmax(dead))
-                    res.deadlock = decode_state(
-                        unflatten_state(np.asarray(drow)[d], dims), dims)
-                    res.stop_reason = "deadlock"
-                    break
-                offset += B
-                if (cfg.max_seconds is not None
-                        and time.time() - t0 > cfg.max_seconds):
-                    res.stop_reason = "duration_budget"
-                    break
+                # Upload the next host segment, balanced across chips.
+                seg = pending.pop(0)
+                while len(seg) > n * QL:
+                    pending.insert(0, seg[n * QL:])
+                    seg = seg[:n * QL]
+                buf = np.zeros((n, QL, sw), ROW_DTYPE)
+                cur_counts = np.zeros((n,), np.int64)
+                share = -(-len(seg) // n)
+                for d in range(n):
+                    part = seg[d * share:(d + 1) * share]
+                    buf[d, :len(part)] = part
+                    cur_counts[d] = len(part)
+                qcur = jax.device_put(buf, NamedSharding(self.mesh, P("x")))
             if res.stop_reason != "exhausted" or res.violation is not None:
                 break
             res.diameter += 1
-            res.levels.append(int(np.asarray(next_counts).sum()))
+            nc = np.asarray(next_counts)
+            res.levels.append(int(nc.sum())
+                              + sum(len(s) for s in spill_next))
             qcur, qnext = qnext, qcur
-            cur_counts = np.asarray(next_counts).copy()
-            next_counts = jnp.zeros((self.n_dev,), _I32)
+            cur_counts = nc.copy()
+            next_counts = jnp.zeros((n,), _I32)
+            pending, spill_next = spill_next, []
 
         res.wall_seconds = time.time() - t0
         return res
 
     # ------------------------------------------------------------------
-    def _capacity_check(self, next_counts, ssize, fail=0):
-        if int(np.asarray(next_counts).max()) > self._QL:
-            raise RuntimeError("per-chip queue capacity exceeded")
-        if fail or int(np.asarray(ssize).max()) > self._CL:
-            raise RuntimeError("per-chip seen-set capacity exceeded")
+    def _drain(self, qnext, ncnt) -> np.ndarray:
+        """All chips' queued rows -> one host array (spill)."""
+        rows = np.asarray(qnext)
+        return np.concatenate([rows[d, :int(ncnt[d])]
+                               for d in range(self.n_dev)]) \
+            if int(np.asarray(ncnt).sum()) else \
+            np.zeros((0, self._sw), ROW_DTYPE)
 
-    def _record(self, trace, tr, l_new):
+    def _maybe_grow(self, shi, slo, ssize):
+        if int(np.asarray(ssize).max()) <= self._CL // 2:
+            return shi, slo, ssize
+        return self._grow_seen(shi, slo, ssize)
+
+    def _write_checkpoint(self, qcur, cur_counts, pending, shi, slo, res,
+                          trace, wall):
+        """Same snapshot format as the single-chip engine: flat frontier +
+        flat key set (chip assignment is recomputed on resume)."""
+        from ..engine import checkpoint as ckpt_mod
+        import os
+        if self.config.record_trace:
+            tf, tp, ta = trace.export()
+            roots = dict(trace.roots)
+        else:
+            tf = np.empty(0, np.uint64)
+            tp = np.empty(0, np.uint64)
+            ta = np.empty(0, np.int32)
+            roots = {}
+        frontier = self._drain(qcur, cur_counts)
+        if pending:
+            frontier = np.concatenate([frontier] + list(pending))
+        hi_h, lo_h = np.asarray(shi), np.asarray(slo)
+        keys_hi, keys_lo = [], []
+        for d in range(self.n_dev):
+            real = ~((hi_h[d] == SENTINEL) & (lo_h[d] == SENTINEL))
+            keys_hi.append(hi_h[d][real])
+            keys_lo.append(lo_h[d][real])
+        keys_hi = np.concatenate(keys_hi) if keys_hi else np.empty(0)
+        keys_lo = np.concatenate(keys_lo) if keys_lo else np.empty(0)
+        order = np.lexsort((keys_lo, keys_hi))
+        ck = ckpt_mod.Checkpoint(
+            dims=self.dims, frontier=frontier,
+            seen_hi=keys_hi[order].astype(np.uint32),
+            seen_lo=keys_lo[order].astype(np.uint32),
+            distinct=res.distinct, generated=res.generated,
+            diameter=res.diameter, levels=tuple(res.levels),
+            wall_seconds=wall,
+            trace_fps=tf, trace_parents=tp, trace_actions=ta, roots=roots)
+        ckpt_mod.save(os.path.join(self.config.checkpoint_dir,
+                                   f"level_{res.diameter:05d}.npz"), ck)
+
+    def _flush_trace(self, trace, tbuf, tcount):
         if not self.config.record_trace:
             return
-        sh, sl, ph, pl, ac = (np.asarray(x) for x in tr)
+        tc = np.asarray(tcount)
+        if not tc.any():
+            return
+        sh, sl, ph, pl, ac = (np.asarray(x) for x in tbuf)
         for d in range(self.n_dev):
-            m = int(l_new[d])
+            m = int(tc[d])
             if m == 0:
                 continue
             fps = ((sh[d, :m].astype(np.uint64) << np.uint64(32))
@@ -386,14 +695,15 @@ class MeshBFSEngine:
                        | pl[d, :m].astype(np.uint64))
             trace.add_batch(fps, parents, ac[d, :m])
 
-    def _check_violation(self, res, vinfo) -> bool:
+    def _check_violation_ingest(self, res, vinfo) -> bool:
         viol_any = np.asarray(vinfo[0])
         if not viol_any.any():
             return False
         d = int(np.argmax(viol_any))
         st = decode_state(
             unflatten_state(np.asarray(vinfo[2])[d], self.dims), self.dims)
-        fp = (int(np.asarray(vinfo[3])[d]) << 32) | int(np.asarray(vinfo[4])[d])
+        fp = (int(np.asarray(vinfo[3])[d]) << 32) \
+            | int(np.asarray(vinfo[4])[d])
         res.violation = Violation(
             invariant=self.inv_names[int(np.asarray(vinfo[1])[d])],
             state=st, fingerprint=fp)

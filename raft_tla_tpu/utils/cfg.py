@@ -278,12 +278,23 @@ def load_config(cfg_path: str, max_log: Optional[int] = None,
 
     bounds = Bounds(max_term=int_const("MaxTerm"),
                     max_log_len=int_const("MaxLogLen"),
-                    max_msg_count=int_const("MaxMsgCount"))
+                    max_msg_count=int_const("MaxMsgCount"),
+                    max_in_flight=int_const("MaxInFlight"))
 
     if cfg.action_constraints:
         raise NotImplementedError(
             f"ACTION_CONSTRAINT {cfg.action_constraints} not supported: "
             "action constraints range over transitions, not states")
+
+    if cfg.properties:
+        # Temporal properties (PROPERTY/PROPERTIES) need liveness checking
+        # (fairness, SCC search over the behavior graph) — a different
+        # algorithm from safety BFS.  Rejected loudly: dropping them would
+        # let a cfg 'pass' a property that was never checked.
+        raise NotImplementedError(
+            f"PROPERTY {cfg.properties} not supported: temporal/liveness "
+            "checking is not implemented; this engine checks INVARIANT "
+            "(safety) properties only")
 
     smoke = cfg.substitutions.get("Init") == "SmokeInit" \
         or cfg.init == "SmokeInit"

@@ -3,6 +3,13 @@
 Times, per call: one big scatter; one big gather; the hash-insert (static
 rounds vs while_loop); the old sorted-merge (full lax.sort) and
 binary-search probe — to decide which dedup design the TPU actually wants.
+
+CAVEAT (measured round 3): under the axon TPU tunnel,
+``block_until_ready`` on repeated same-input calls does not reliably
+reflect device time — numbers here flip-flopped by 1000x between
+sessions.  Treat these as CPU-backend sanity numbers; for trustworthy TPU
+timings use scripts/true_bench.py (fori_loop-chained iterations, host
+scalar fetch as the barrier) or end-to-end engine runs.
 """
 
 import sys

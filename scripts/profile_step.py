@@ -1,5 +1,29 @@
-"""Time one real BFS engine step on the ambient platform, separating
-device compute from host round-trips — to find where the states/sec go."""
+"""Decompose one BFS batch into its device kernels and time each on the
+ambient platform (TPU under the driver; CPU anywhere).  This is the
+instrument for the round-3 performance work: run it before and after any
+engine change and commit the numbers.
+
+Parts timed (all jitted separately, block_until_ready between):
+  expand        rows -> candidate StateBatch [B,G] + enabled
+  flatten       candidates -> flat uint8 rows [B*G, SW]
+  fingerprint   rows -> (hi, lo) uint32 lanes
+  sort-dedup    the in-batch dedup sort over the padded batch
+  probe-insert  fpset.insert_unique on the DEDUPED keys (the real path;
+                raw keys would violate its distinct-keys precondition and
+                measure a duplicate-collision pathology production never pays)
+  full-insert   fpset.insert (sort + probes)
+  enqueue       cumsum + scatter of new rows into the next queue
+  CHUNK         the engine's real fused chunk program, 1 batch/call
+  CHUNK x8      ditto, 8 batches per call (sync_every amortization)
+
+Run:  python scripts/profile_step.py [batch]
+
+CAVEAT: under the axon TPU tunnel, repeated same-input timings have shown
+1000x session-to-session swings (block_until_ready is not a reliable
+barrier there).  Cross-check any surprising number against
+scripts/true_bench.py (fori_loop-chained, host-fetch barrier) and against
+an end-to-end engine run before acting on it.
+"""
 
 import sys
 import time
@@ -11,71 +35,150 @@ import jax.numpy as jnp
 import numpy as np
 
 from raft_tla_tpu.engine.bfs import EngineConfig
-from raft_tla_tpu.engine.check import make_engine
-from raft_tla_tpu.models.pystate import init_state
-from raft_tla_tpu.models.schema import encode_state, flatten_state
-from raft_tla_tpu.utils.cfg import load_config
+from raft_tla_tpu.engine.check import initial_states, make_engine
+from raft_tla_tpu.models.actions import build_expand
+from raft_tla_tpu.models.schema import (flatten_state, unflatten_state,
+                                        encode_state)
 from raft_tla_tpu.ops import fpset
+from raft_tla_tpu.ops.fingerprint import build_fingerprint
+from raft_tla_tpu.utils.cfg import load_config
+
+
+def bench(label, fn, *args, n=10, **kw):
+    out = fn(*args, **kw)          # compile
+    jax.block_until_ready(out)
+    t0 = time.time()
+    for _ in range(n):
+        out = fn(*args, **kw)
+    jax.block_until_ready(out)
+    ms = (time.time() - t0) / n * 1e3
+    print(f"{label:42s} {ms:9.2f} ms")
+    return ms, out
 
 
 def main():
     print("platform:", jax.devices()[0].platform)
+    B = int(sys.argv[1]) if len(sys.argv) > 1 else 2048
+    from raft_tla_tpu.utils.platform import enable_persistent_cache
+    enable_persistent_cache()
     setup = load_config("configs/MCraft_bounded.cfg")
-    cfg = EngineConfig(batch=2048, queue_capacity=1 << 20,
-                       seen_capacity=1 << 23, record_trace=False)
-    eng = make_engine(setup, cfg)
     dims = setup.dims
-    print("dims:", dims, "G:", dims.n_instances, "SW:", eng._sw)
+    cfg = EngineConfig(batch=B, queue_capacity=1 << 20,
+                       seen_capacity=1 << 23, record_trace=False,
+                       check_deadlock=False)
+    eng = make_engine(setup, cfg)
+    G, SW, Q = eng._G, eng._sw, eng._Q
+    print(f"dims: {dims}  B={B} G={G} SW={SW} B*G={B*G}")
 
-    row = flatten_state(encode_state(init_state(dims), dims), dims)
-    Q = eng._Q
-    qcur = jnp.asarray(np.tile(row[None, :], (Q, 1)).astype(np.int32))
-    B = cfg.batch
+    # A realistic frontier: run the engine for a few levels and snapshot a
+    # mid-level frontier, so the benchmarked batch has representative
+    # duplication/occupancy (tiled roots would collapse to ~G distinct
+    # candidates and flatter the dedup path).
+    warm = make_engine(setup, EngineConfig(
+        batch=B, queue_capacity=1 << 20, seen_capacity=1 << 23,
+        record_trace=False, check_deadlock=False, max_diameter=4))
+    wres = warm.run(initial_states(setup))
+    wrows = warm._last_frontier
+    print(f"warm-up frontier: {len(wrows)} states at diameter "
+          f"{wres.diameter} ({wres.distinct} distinct seen)")
+    reps = -(-Q // len(wrows))
+    qcur = jnp.asarray(np.tile(wrows, (reps, 1))[:Q])
 
-    def fresh():
-        return (jnp.zeros((Q, eng._sw), jnp.int32),
-                fpset.empty(cfg.seen_capacity))
+    expand = build_expand(dims)
+    fingerprint = build_fingerprint(dims)
 
-    # Warm-up/compile.
-    qnext, seen = fresh()
-    out = eng._step(qcur, jnp.int32(B), jnp.int32(0), qnext, jnp.int32(0),
-                    seen)
+    @jax.jit
+    def part_expand(rows):
+        states = jax.vmap(unflatten_state, (0, None))(rows, dims)
+        cands, en, ovf = jax.vmap(expand)(states)
+        return jax.tree.map(lambda a: a.sum(), cands), en.sum()
+
+    @jax.jit
+    def part_expand_flatten(rows):
+        states = jax.vmap(unflatten_state, (0, None))(rows, dims)
+        cands, en, ovf = jax.vmap(expand)(states)
+        cflat = jax.tree.map(
+            lambda a: a.reshape((B * G,) + a.shape[2:]), cands)
+        crows = jax.vmap(flatten_state, (0, None))(cflat, dims)
+        return crows, en.reshape(-1)
+
+    @jax.jit
+    def part_fingerprint(crows):
+        cands = jax.vmap(unflatten_state, (0, None))(crows, dims)
+        return jax.vmap(fingerprint)(cands)
+
+    @jax.jit
+    def part_sort(fph, fpl, en):
+        (qh, ql, v), k = fpset._pad_pow2(
+            (fph, fpl, en), (fpset.SENTINEL, fpset.SENTINEL, False))
+        return fpset.dedup_batch(qh, ql, v)
+
+    @jax.jit
+    def part_probes(seen, fph, fpl, en):
+        return fpset.insert_unique(seen, fph, fpl, en)
+
+    @jax.jit
+    def part_insert(seen, fph, fpl, en):
+        return fpset.insert(seen, fph, fpl, en)
+
+    @jax.jit
+    def part_enqueue(qnext, next_count, crows, enq):
+        pos = next_count + jnp.cumsum(enq.astype(jnp.int32)) - 1
+        pos = jnp.where(enq, pos, Q)
+        qnext = qnext.at[pos].set(crows, mode="drop")
+        return qnext, next_count + jnp.sum(enq, dtype=jnp.int32)
+
+    rows = qcur[:B]
+    bench("expand (no flatten)", part_expand, rows)
+    _, (crows, en) = bench("expand + flatten", part_expand_flatten, rows)
+    _, (fph, fpl) = bench("fingerprint (on B*G rows)", part_fingerprint,
+                          crows)
+    _, ((sh, sl), _order, first) = bench("sort-dedup (padded batch)",
+                                         part_sort, fph, fpl, en)
+    seen = fpset.empty(cfg.seen_capacity)
+    bench("probe-insert (32 rounds, deduped keys)", part_probes, seen, sh,
+          sl, first)
+    bench("full fpset.insert (sort + probes)", part_insert, seen, fph, fpl,
+          en)
+    qnext = jnp.zeros((Q, SW), jnp.uint8)
+    bench("enqueue scatter", part_enqueue, qnext, jnp.int32(0), crows, en)
+
+    # The engine's own fused chunk program (qnext/seen/tbuf are donated:
+    # thread the outputs back through).
+    tbuf = tuple(jnp.zeros((eng._TQ,), d) for d in
+                 (jnp.uint32, jnp.uint32, jnp.uint32, jnp.uint32, jnp.int32))
+
+    def chunk_once(qnext, seen, tbuf):
+        return eng._chunk(qcur, jnp.int32(B), jnp.int32(0), qnext,
+                          jnp.int32(0), seen, tbuf, jnp.int32(0),
+                          jnp.int32(1))
+
+    out = chunk_once(qnext, seen, tbuf)     # compile + warm
     jax.block_until_ready(out)
-
-    # Pure device time: run 10 steps, sync once at the end.
     n = 10
-    qnext, seen = fresh()
-    nc = jnp.int32(0)
     t0 = time.time()
     for _ in range(n):
-        out = eng._step(qcur, jnp.int32(B), jnp.int32(0), qnext, nc, seen)
-        qnext, nc, seen = out[0], out[1], out[2]
+        out = chunk_once(out[0], out[1], out[2])
     jax.block_until_ready(out)
-    dev_ms = (time.time() - t0) / n * 1e3
-    print(f"device-only step                    {dev_ms:9.2f} ms")
-
-    # Step + the host scalar fetches the run loop does.
-    qnext, seen = fresh()
-    nc = jnp.int32(0)
-    t0 = time.time()
-    for _ in range(n):
-        out = eng._step(qcur, jnp.int32(B), jnp.int32(0), qnext, nc, seen)
-        qnext, nc, seen, stats = out[0], out[1], out[2], out[3]
-        _ = (int(stats[0]), int(stats[1]), int(stats[2]), bool(stats[3]),
-             bool(stats[4]))
-        _ = int(seen.size)
-        _ = int(nc)
-        _ = bool(out[5][0])
-    sync_ms = (time.time() - t0) / n * 1e3
-    print(f"step + host scalar fetches          {sync_ms:9.2f} ms")
-
-    # One scalar round-trip (tunnel RTT floor).
-    x = jnp.int32(7)
-    t0 = time.time()
-    for _ in range(n):
-        _ = int(x + 1)
-    print(f"single scalar device->host fetch    "
+    print(f"{'CHUNK (1 batch, fused program)':42s} "
           f"{(time.time() - t0) / n * 1e3:9.2f} ms")
+    st = np.asarray(out[3])
+    print(f"  chunk stats: offset={st[0]} steps={st[1]} next={st[2]} "
+          f"seen={st[3]} gen={st[5]} new={st[6]}")
+
+    def chunk8(qnext, seen, tbuf):
+        return eng._chunk(qcur, jnp.int32(8 * B), jnp.int32(0), qnext,
+                          jnp.int32(0), seen, tbuf, jnp.int32(0),
+                          jnp.int32(8))
+
+    out = chunk8(out[0], out[1], out[2])    # warm (same compiled program)
+    jax.block_until_ready(out)
+    t0 = time.time()
+    for _ in range(n):
+        out = chunk8(out[0], out[1], out[2])
+    jax.block_until_ready(out)
+    print(f"{'CHUNK x8 (8 batches per call)':42s} "
+          f"{(time.time() - t0) / n / 8 * 1e3:9.2f} ms/batch")
 
 
 if __name__ == "__main__":

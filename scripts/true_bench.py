@@ -42,14 +42,31 @@ def main():
     dims = setup.dims
     B, G = 2048, dims.n_instances
     K = B * G
-    ck = np.load("/tmp/ck/level_00008.npz", allow_pickle=True)
-    rows = jnp.asarray(ck["frontier"][:B].astype(np.int32))
-    d = np.load("/tmp/realkeys.npz")
-    fph = jnp.asarray(d["fph"])
-    fpl = jnp.asarray(d["fpl"])
-    enf = jnp.asarray(d["enf"])
+    # Workload generated in-process (runs from a fresh clone): a few real
+    # BFS levels supply a representative mid-level frontier, and one
+    # expand+fingerprint pass over it supplies real candidate keys.
+    from raft_tla_tpu.engine.bfs import EngineConfig
+    from raft_tla_tpu.engine.check import initial_states, make_engine
+    warm = make_engine(setup, EngineConfig(
+        batch=B, queue_capacity=1 << 20, seen_capacity=1 << 23,
+        record_trace=False, check_deadlock=False, max_diameter=4))
+    warm.run(initial_states(setup))
+    wrows = warm._last_frontier
+    rows = jnp.asarray(np.tile(wrows, (-(-B // len(wrows)), 1))[:B])
     expand = build_expand(dims)
     fingerprint = build_fingerprint(dims)
+
+    @jax.jit
+    def mkkeys(rows):
+        states = jax.vmap(unflatten_state, (0, None))(rows, dims)
+        cands, en, _ovf = jax.vmap(expand)(states)
+        cflat = jax.tree.map(lambda a: a.reshape((K,) + a.shape[2:]), cands)
+        crows = jax.vmap(flatten_state, (0, None))(cflat, dims)
+        st2 = jax.vmap(unflatten_state, (0, None))(crows, dims)
+        fh, fl = jax.vmap(fingerprint)(st2)
+        return fh, fl, en.reshape(-1)
+
+    fph, fpl, enf = mkkeys(rows)
     C = 1 << 23
 
     @jax.jit
@@ -95,7 +112,7 @@ def main():
     def loop_expand(rows):
         def body(i, acc):
             states = jax.vmap(unflatten_state, (0, None))(
-                rows.at[0, 0].add(i), dims)
+                rows.at[0, 0].add(i.astype(rows.dtype)), dims)
             cands, en, ovf = jax.vmap(expand)(states)
             cflat = jax.tree.map(
                 lambda a: a.reshape((K,) + a.shape[2:]), cands)
@@ -111,7 +128,7 @@ def main():
     def loop_fp(rows):
         def body(i, acc):
             states = jax.vmap(unflatten_state, (0, None))(
-                rows.at[0, 0].add(i), dims)
+                rows.at[0, 0].add(i.astype(rows.dtype)), dims)
             cands, en, ovf = jax.vmap(expand)(states)
             cflat = jax.tree.map(
                 lambda a: a.reshape((K,) + a.shape[2:]), cands)
@@ -125,11 +142,11 @@ def main():
     t_fp = timed("expand+flatten+fingerprint", loop_fp, rows)
 
     Q = 1 << 20
-    crows = jnp.zeros((K, 473), jnp.int32)
+    crows = jnp.zeros((K, 473), jnp.uint8)
 
     @jax.jit
     def loop_enqueue(crows, enf):
-        qnext = jnp.zeros((Q, 473), jnp.int32)
+        qnext = jnp.zeros((Q, 473), jnp.uint8)
 
         def body(i, carry):
             qnext, acc = carry
@@ -137,7 +154,7 @@ def main():
             pos = jnp.cumsum(enq.astype(jnp.int32)) - 1
             pos = jnp.where(enq, pos + i, Q)
             qnext = qnext.at[pos].set(crows, mode="drop")
-            return qnext, acc + qnext[0, 0]
+            return qnext, acc + qnext[0, 0].astype(jnp.int32)
 
         qnext, acc = jax.lax.fori_loop(0, N, body, (qnext, jnp.int32(0)))
         return acc
@@ -150,7 +167,7 @@ def main():
 
         def body(i, acc):
             sel = crows[order + i - i]      # row gather 270k x 473
-            return acc + sel[0, 0]
+            return acc + sel[0, 0].astype(jnp.int32)
 
         return jax.lax.fori_loop(0, N, body, jnp.int32(0))
 

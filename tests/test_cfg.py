@@ -105,3 +105,21 @@ def test_backend_directives_reach_engine_config():
     assert ec.queue_capacity == 1 << 22
     assert ec.seen_capacity == 1 << 25
     assert ec.checkpoint_interval_seconds == 300.0
+
+
+def test_property_rejected_loudly(tmp_path):
+    """A temporal PROPERTY must fail the load, mirroring ACTION_CONSTRAINT:
+    silently dropping it would let the cfg 'pass' a property that was
+    never checked (liveness needs a different algorithm than safety BFS)."""
+    cfgf = tmp_path / "liveness.cfg"
+    cfgf.write_text(
+        "CONSTANTS\n    Server = {r1, r2, r3}\n    Value = {v1}\n"
+        "    Follower = Follower\n    Candidate = Candidate\n"
+        "    Leader = Leader\n    Nil = Nil\n"
+        "    RequestVoteRequest = RequestVoteRequest\n"
+        "    RequestVoteResponse = RequestVoteResponse\n"
+        "    AppendEntriesRequest = AppendEntriesRequest\n"
+        "    AppendEntriesResponse = AppendEntriesResponse\n"
+        "SPECIFICATION Spec\nPROPERTY EventuallyLeader\n")
+    with pytest.raises(NotImplementedError, match="EventuallyLeader"):
+        load_config(str(cfgf))

@@ -125,12 +125,96 @@ def test_multiple_init_states(engine_cls=BFSEngine):
     assert res.levels == want.levels
 
 
+# MCraft_bounded exact level profile (frontier sizes per level), measured
+# by the independent digest-based oracle sweep of 2026-07-29
+# (scripts/oracle_exhaust.py; BASELINE.md §b).  The engine must reproduce
+# this prefix exactly — the SURVEY §4 differential contract at real depth.
+MCRAFT_BOUNDED_LEVELS = [1, 3, 18, 79, 318, 1218, 4433, 15510, 52467,
+                         172129, 548904, 1703703, 5151868, 15187022]
+MCRAFT_BOUNDED_DISTINCT_L7 = 37054     # cumulative distinct through L7
+# (includes constraint-violating states: counted, never expanded)
+MCRAFT_BOUNDED_GEN_L7 = 99489          # cumulative generated through L7
+
+
+def test_levels_match_pinned_oracle_profile():
+    """Engine vs the pinned full-scale oracle profile, through level 7
+    (37k distinct — deep enough to cross several spills/growths of a tiny
+    engine, cheap enough for the single-core CPU suite)."""
+    from raft_tla_tpu.engine.check import initial_states, make_engine
+    from raft_tla_tpu.utils.cfg import load_config
+    import os
+    here = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    setup = load_config(os.path.join(here, "configs/MCraft_bounded.cfg"))
+    eng = make_engine(setup, small_config(
+        batch=256, queue_capacity=1 << 13, seen_capacity=1 << 14,
+        max_diameter=7, record_trace=False))
+    res = eng.run(initial_states(setup))
+    assert res.levels == MCRAFT_BOUNDED_LEVELS[:8]
+    assert res.distinct == MCRAFT_BOUNDED_DISTINCT_L7
+    assert res.generated == MCRAFT_BOUNDED_GEN_L7
+    assert res.violation is None
+
+
 def test_duration_budget_stops():
     eng = BFSEngine(DIMS, constraint=build_constraint(DIMS, BOUNDS),
                     config=small_config(max_seconds=0.0))
     res = eng.run([init_state(DIMS)])
     assert res.stop_reason == "duration_budget"
     assert res.distinct >= 1
+
+
+def test_duration_budget_promptness():
+    """StopAfter must be honored to within ~a batch, not a whole
+    sync_every chunk (round-2 BENCH overshot a 45 s budget by 66%).  The
+    engine sizes each chunk call from its measured per-batch cost, so the
+    overshoot is bounded by a few batches regardless of sync_every."""
+    budget = 2.0
+    eng = BFSEngine(DIMS, constraint=build_constraint(DIMS, BOUNDS),
+                    config=small_config(max_seconds=budget, sync_every=64))
+    res = eng.run([init_state(DIMS)])
+    if res.stop_reason == "exhausted":
+        pytest.skip("machine fast enough to exhaust inside the budget")
+    assert res.stop_reason == "duration_budget"
+    slack = max(3 * eng._batch_ema, 1.0)
+    assert res.wall_seconds <= budget + slack, \
+        (res.wall_seconds, budget, eng._batch_ema)
+
+
+def test_order_independence_of_exploration():
+    """Metamorphic (SURVEY §5.2, the race-detector analog): the distinct
+    count, per-level sizes, and diameter are invariant under (a) frontier
+    permutation and (b) batch-boundary changes — guards the claim-scatter
+    insert protocol and in-batch dedup against order effects."""
+    s = init_state(DIMS)
+    roots = [s,
+             s.replace(role=(1, 0, 0), current_term=(2, 1, 1)),
+             s.replace(role=(0, 1, 0), current_term=(1, 2, 1)),
+             s.replace(role=(2, 0, 0), votes_granted=(0b11, 0, 0))]
+    base = BFSEngine(DIMS, constraint=build_constraint(DIMS, BOUNDS),
+                     config=small_config(max_diameter=3))
+    want = base.run(list(roots))
+    for perm, batch in (([3, 1, 0, 2], 32), ([2, 0, 3, 1], 8)):
+        eng = BFSEngine(DIMS, constraint=build_constraint(DIMS, BOUNDS),
+                        config=small_config(batch=batch, max_diameter=3))
+        got = eng.run([roots[i] for i in perm])
+        assert got.distinct == want.distinct
+        assert got.levels == want.levels
+        assert got.generated == want.generated
+        assert got.diameter == want.diameter
+
+
+def test_smokeraft_cfg_end_to_end():
+    """The reference Smokeraft.cfg (randomized init, StopAfter budgets,
+    CHECK_DEADLOCK FALSE) runs unmodified through the cfg front-end and the
+    engine: budget stop (or exhaustion of the random slice) with nonzero
+    distinct states and no violation."""
+    from raft_tla_tpu.engine.check import run_check
+    res = run_check("/root/reference/Smokeraft.cfg",
+                    engine_config=small_config(batch=128))
+    assert res.violation is None
+    assert res.distinct > 0
+    assert res.stop_reason in ("duration_budget", "diameter_budget",
+                               "exhausted")
 
 
 def test_spill_to_host_matches_unspilled():

@@ -60,3 +60,98 @@ def test_mesh_trace_replay():
     assert steps[-1][1] == res.violation.state
     for (g_prev, s_prev), (g, s_next) in zip(steps, steps[1:]):
         assert s_next in orc.successor_set(s_prev, DIMS)
+
+
+def small_mesh_config(**kw):
+    base = dict(batch=16, queue_capacity=1 << 12, seen_capacity=1 << 15,
+                check_deadlock=False)
+    base.update(kw)
+    return EngineConfig(**base)
+
+
+def test_mesh_spill_to_host_matches_roomy():
+    """Per-chip queue overflow must drain to the host pool (and re-upload
+    balanced) without changing any count — single-chip parity for the
+    spill path the round-2 mesh engine lacked."""
+    cons = build_constraint(DIMS, BOUNDS)
+    want = MeshBFSEngine(DIMS, constraint=cons,
+                         config=small_mesh_config(max_diameter=4)).run(
+        [init_state(DIMS)])
+    # queue_capacity 8/chip rounds up to one batch (= B*G watermark 0):
+    # every chunk spills.
+    got = MeshBFSEngine(DIMS, constraint=cons,
+                        config=small_mesh_config(
+                            batch=8, queue_capacity=8, sync_every=4,
+                            max_diameter=4)).run([init_state(DIMS)])
+    assert got.distinct == want.distinct
+    assert got.levels == want.levels
+    assert got.generated == want.generated
+
+
+def test_mesh_seen_set_grows():
+    """Shard growth (host rehash at half load) must keep counts exact."""
+    cons = build_constraint(DIMS, BOUNDS)
+    want = MeshBFSEngine(DIMS, constraint=cons,
+                         config=small_mesh_config(max_diameter=3)).run(
+        [init_state(DIMS)])
+    small = MeshBFSEngine(DIMS, constraint=cons,
+                          config=small_mesh_config(
+                              batch=8, sync_every=1, seen_capacity=8,
+                              max_diameter=3))
+    got = small.run([init_state(DIMS)])
+    assert got.distinct == want.distinct
+    assert got.levels == want.levels
+
+
+def test_mesh_checkpoint_resumes_on_mesh_and_single(tmp_path):
+    """Mesh checkpoints use the single-chip snapshot format: a run
+    interrupted on the mesh must resume bit-exactly BOTH on a mesh (even a
+    different device count) and on the single-chip engine."""
+    cons = build_constraint(DIMS, BOUNDS)
+    want = MeshBFSEngine(DIMS, constraint=cons,
+                         config=small_mesh_config(max_diameter=4)).run(
+        [init_state(DIMS)])
+    ck = str(tmp_path / "ck")
+    MeshBFSEngine(DIMS, constraint=cons,
+                  config=small_mesh_config(
+                      max_diameter=3, record_trace=False,
+                      checkpoint_dir=ck)).run([init_state(DIMS)])
+    from raft_tla_tpu.engine import checkpoint as ckpt_mod
+    path = ckpt_mod.latest(ck)
+    assert path is not None
+
+    import jax as _jax
+    got_mesh = MeshBFSEngine(
+        DIMS, constraint=cons,
+        config=small_mesh_config(max_diameter=4, record_trace=False),
+        devices=_jax.devices()[:4]).run(resume=path)
+    assert got_mesh.distinct == want.distinct
+    assert got_mesh.levels == want.levels
+    assert got_mesh.diameter == want.diameter
+
+    got_single = BFSEngine(
+        DIMS, constraint=cons,
+        config=small_mesh_config(max_diameter=4, record_trace=False,
+                                 queue_capacity=1 << 13)).run(resume=path)
+    assert got_single.distinct == want.distinct
+    assert got_single.levels == want.levels
+
+
+def test_mesh_order_independence():
+    """Root permutation and batch-boundary changes must not change mesh
+    counts (guards the owner-routed all_to_all dedup)."""
+    cons = build_constraint(DIMS, BOUNDS)
+    s = init_state(DIMS)
+    roots = [s,
+             s.replace(role=(1, 0, 0), current_term=(2, 1, 1)),
+             s.replace(role=(0, 1, 0), current_term=(1, 2, 1)),
+             s.replace(role=(2, 0, 0), votes_granted=(0b11, 0, 0))]
+    want = MeshBFSEngine(DIMS, constraint=cons,
+                         config=small_mesh_config(max_diameter=2)).run(
+        list(roots))
+    got = MeshBFSEngine(DIMS, constraint=cons,
+                        config=small_mesh_config(batch=8, max_diameter=2)
+                        ).run([roots[i] for i in (3, 1, 0, 2)])
+    assert got.distinct == want.distinct
+    assert got.levels == want.levels
+    assert got.generated == want.generated
