@@ -29,7 +29,35 @@ ORACLE_SECONDS = float(os.environ.get("BENCH_ORACLE_SECONDS",
                                       str(BENCH_SECONDS)))
 
 
+def _tpu_tunnel_alive(timeout_s: float = 120.0) -> bool:
+    """Probe the accelerator in a SUBPROCESS with a hard timeout.
+
+    A wedged TPU tunnel (observed: the axon relay accepts the connection
+    but the remote terminal never answers) blocks ``jax.devices()``
+    inside an uninterruptible recv — an in-process try/except can't help.
+    Probing in a disposable child process turns "hang forever" into a
+    recorded CPU-fallback run."""
+    import subprocess
+    try:
+        r = subprocess.run(
+            [sys.executable, "-c",
+             "import jax; assert jax.devices()[0].platform != 'cpu'"],
+            timeout=timeout_s, capture_output=True)
+        return r.returncode == 0
+    except subprocess.TimeoutExpired:
+        return False
+
+
 def main():
+    # The sitecustomize hook registers the axon backend even when
+    # JAX_PLATFORMS is unset (utils/platform.py) — probe unless CPU was
+    # explicitly requested.
+    if "cpu" not in os.environ.get("JAX_PLATFORMS", "") \
+            and not _tpu_tunnel_alive():
+        print("bench: TPU tunnel unresponsive; falling back to CPU",
+              file=sys.stderr)
+        from raft_tla_tpu.utils.platform import force_cpu
+        force_cpu()
     import jax
 
     platform = None

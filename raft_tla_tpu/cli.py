@@ -63,7 +63,10 @@ def main(argv=None):
 
     s = sub.add_parser("simulate", help="random-trace simulation")
     common(s)
-    s.add_argument("--num-steps", type=int, default=1 << 20)
+    # Default sized for the BASELINE workload (1M traces x depth 100 ~=
+    # 1e8 walker-steps) — minutes on a TPU chip; use --max-seconds or a
+    # smaller --num-steps on CPU.
+    s.add_argument("--num-steps", type=int, default=1 << 27)
     s.add_argument("--depth", type=int, default=100)
     s.add_argument("--max-seconds", type=float, default=None)
 

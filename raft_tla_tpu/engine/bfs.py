@@ -6,11 +6,16 @@ to one XLA program per step:
 
     slice B states off the current-level queue
       -> vmap(expand): all G action instances of all B states   [B,G]
-      -> vmap(fingerprint) over the B*G candidates
-      -> batched hash-table insert (ops/fpset.py): one pass that dedups
-         the batch AND probes/updates the HBM seen-set — no sorts
-      -> scatter new+constraint-passing states into the next-level queue
-      -> invariant ids, deadlock mask, violation/overflow reporting
+      -> vmap(fingerprint) over the B*G candidates (cheap reduce per lane)
+      -> COMPACT the enabled lanes to K << B*G slots (prefix-sum scatter;
+         measured fan-out is ~6% of G, so K = 16*B loses nothing, and a
+         fan-out burst just advances fewer parents that step)
+      -> batched hash-table insert (ops/fpset.py) on the K compacted keys:
+         in-batch dedup + HBM seen-set probe/update in one pass
+      -> gather the K candidate states; materialize uint8 rows, evaluate
+         invariants + the state constraint, scatter the new rows into the
+         next-level queue — all O(K), never O(B*G)
+      -> deadlock mask, violation/overflow reporting
 
 Everything device-resident: the two level queues (flat uint8 state rows),
 the FPSet, and all masks.  The host loop only advances offsets, swaps queues
@@ -52,6 +57,7 @@ from ..models.pystate import PyState
 from ..models.schema import (ROW_DTYPE, StateBatch, build_pack_guard,
                              check_packable, decode_state, encode_state,
                              flatten_state, state_width, unflatten_state)
+from ..ops import compact as compact_mod
 from ..ops import fpset
 from ..ops.fingerprint import build_fingerprint
 
@@ -68,6 +74,16 @@ class EngineConfig:
     # threshold; these set the *device-resident* working set.
     queue_capacity: Optional[int] = 1 << 16
     seen_capacity: Optional[int] = 1 << 18
+    # Width (lanes) of the compacted-candidate buffer: the B*G enabled
+    # masks are prefix-summed into this many lanes before the hash insert,
+    # row materialization, invariant/constraint evaluation, and enqueue —
+    # so those stages cost O(K), not O(B*G).  Enabled fraction is typically
+    # well under 10% (measured fan-out ~8 of G=132 on MCraft_bounded), so
+    # the default of 16 lanes per frontier state loses nothing; when a
+    # batch's fan-out does exceed K the device loop simply takes fewer
+    # parents that step (progress-limited, never dropped).  None => auto
+    # (16*batch, clamped to [G, B*G], power of two).
+    compact_lanes: Optional[int] = None
     # None = defer to the cfg file (make_engine fills it in); a bool from
     # the caller always wins — the documented precedence chain.
     check_deadlock: Optional[bool] = None
@@ -203,6 +219,9 @@ class BFSEngine:
         pack_ok = build_pack_guard(dims)
         sw = state_width(dims)
         B, G = cfg.batch, dims.n_instances
+        BG = B * G
+        # Compacted-candidate lanes (ops/compact.py owns the invariants).
+        K = compact_mod.choose_k(B, G, cfg.compact_lanes)
         qreq, sreq = cfg.queue_capacity, cfg.seen_capacity
         if qreq is None or sreq is None:
             auto_q, auto_s = _auto_capacities(sw, B, cfg.record_trace)
@@ -212,17 +231,21 @@ class BFSEngine:
         # loop stops for growth at half-full, so a single batch can then
         # push the load at most to 1/2 + 1/8 — far from where double-hash
         # probes start failing.  (fpset rounds up to a power of two.)
-        self._seen_cap = max(sreq, 8 * B * G)
-        # Queue offsets advance in whole batches; capacity must be a
-        # multiple of batch so dynamic_slice never clamps (which would
-        # silently shift the window off the intended rows).  It is also
-        # floored at one worst-case batch (B*G rows, every candidate new):
-        # a single batch may never overflow the queue, because the enqueue
-        # scatter drops out-of-range rows — the spill watermark can only
-        # guard *between* batches.  Rounded copy kept on self — the
-        # caller's config is not mutated.
-        Q = max(-(-qreq // B) * B, B * G)
+        self._seen_cap = max(sreq, 8 * K)
+        # Queue capacity: floored at one worst-case batch (K rows, every
+        # compacted candidate new) — a batch entering at/below the spill
+        # watermark (Q - K) can then never overflow.  Rounded to a multiple
+        # of B for tidy level slicing.  The device allocation carries PAD
+        # extra rows past Q: B so the batch dynamic_slice near the queue
+        # end never clamps (a clamp would silently re-window the slice),
+        # and K of scatter "trash" so masked-off enqueue lanes each write
+        # to their own distinct address beyond the live region — a shared
+        # drop index serializes the TPU scatter (ops/fpset.py design note
+        # 3).  Rounded copies kept on self — the config is not mutated.
+        Q = max(-(-qreq // B) * B, K)
+        PAD = max(B, K)
         self._sw, self._B, self._G, self._Q = sw, B, G, Q
+        self._K, self._PAD = K, PAD
 
         def absorb(crows, en, parent_hi, parent_lo, actions,
                    qnext, next_count, seen):
@@ -284,25 +307,26 @@ class BFSEngine:
         # fetches the few relevant rows only when a flag is set.
         CH = self._CH = max(1, cfg.sync_every)
         # Trace-buffer rows: enough that a fresh chunk (tcount=0) always
-        # has room for >= 1 batch, else the loop could make no progress.
-        # With tracing off the buffers shrink to stubs and every trace
-        # scatter (and the parents-only fingerprint pass) compiles out —
-        # raw-throughput runs pay nothing for the feature.
+        # has room for >= 1 batch (<= K new states), else the loop could
+        # make no progress.  With tracing off the buffers shrink to stubs
+        # and every trace scatter (and the parents-only fingerprint pass)
+        # compiles out — raw-throughput runs pay nothing for the feature.
         record_static = cfg.record_trace
-        TQ = Q + B * G if record_static else 8
+        TQ = Q + K if record_static else 8
         # None (config default) = TLC's default: deadlock checking on.
         self._check_deadlock = (True if cfg.check_deadlock is None
                                 else cfg.check_deadlock)
         check_deadlock_static = self._check_deadlock
         # The next-level queue must always have room for one worst-case
-        # batch (every instance of every state new): the device loop stops
-        # at this watermark and the host spills the queue to its memory
-        # (TLC's disk-backed state queue, SURVEY §2.4 R8).  Q >= B*G, so a
+        # batch (every compacted candidate new): the device loop stops at
+        # this watermark and the host spills the queue to its memory
+        # (TLC's disk-backed state queue, SURVEY §2.4 R8).  Q >= K, so a
         # batch always runs when the count is at/below the watermark and
-        # can never overflow; when Q == B*G exactly (tiny test configs)
+        # can never overflow; when Q == K exactly (tiny test configs)
         # every batch triggers a spill — correct, just not fast.
-        QTH = Q - B * G
+        QTH = Q - K
         self._QTH = QTH
+        compactor = compact_mod.build_compactor(B, G, K)
 
         def chunk_body(qcur, cur_count, carry):
             (offset, steps, qnext, next_count, seen, tbuf, tcount,
@@ -317,58 +341,73 @@ class BFSEngine:
             # overflow too (schema.build_pack_guard): stop, never alias.
             ovf = (ovf | (en & ~jax.vmap(jax.vmap(pack_ok))(cands))) \
                 & valid[:, None]
-            dead_b = valid & ~jnp.any(en, axis=1) & ~jnp.any(ovf, axis=1)
+
+            # Progress limiting + lane compaction (ops/compact.py): take
+            # the longest parent prefix whose fan-out fits K, compact the
+            # enabled lanes to K slots — nothing is ever dropped, a
+            # fan-out burst just advances fewer parents this step.
+            P, total, lane_id, kvalid = compactor(en)
+            ptaken = jnp.arange(B, dtype=_I32) < P
+            en = en & ptaken[:, None]
+            ovf = ovf & ptaken[:, None]
+            dead_b = valid & ptaken & ~jnp.any(en, axis=1) \
+                & ~jnp.any(ovf, axis=1)
             dead_any_b = jnp.any(dead_b)
             drow_b = rows[jnp.argmax(dead_b)]
 
+            # Fingerprints for all B*G lanes, straight off the candidate
+            # structs (identical to hashing the packed rows whenever
+            # pack_ok holds — and any overflow aborts the run above).
             cflat = jax.tree.map(
-                lambda a: a.reshape((B * G,) + a.shape[2:]), cands)
-            crows = jax.vmap(flatten_state, (0, None))(cflat, dims)
-            cands2 = jax.vmap(unflatten_state, (0, None))(crows, dims)
-            fph, fpl = jax.vmap(fingerprint)(cands2)
-            enf = en.reshape(-1)
-            seen, new, fail = fpset.insert(seen, fph, fpl, enf)
+                lambda a: a.reshape((BG,) + a.shape[2:]), cands)
+            fph, fpl = jax.vmap(fingerprint)(cflat)             # [BG]
 
+            kh, kl = fph[lane_id], fpl[lane_id]
+            seen, new, fail = fpset.insert(seen, kh, kl, kvalid)
+
+            # Everything below runs on the K compacted lanes only.
+            kstates = jax.tree.map(lambda a: a[lane_id], cflat)
             if inv_fns:
-                inv = jax.vmap(build_inv_id(inv_fns))(cands2)
+                inv = jax.vmap(build_inv_id(inv_fns))(kstates)
             else:
-                inv = jnp.full((B * G,), -1, _I32)
+                inv = jnp.full((K,), -1, _I32)
             viol = new & (inv >= 0)
             viol_any_b = jnp.any(viol)
             vpos = jnp.argmax(viol)
 
             if constraint is not None:
-                cons_ok = jax.vmap(constraint)(cands2)
+                cons_ok = jax.vmap(constraint)(kstates)
             else:
-                cons_ok = jnp.ones((B * G,), bool)
+                cons_ok = jnp.ones((K,), bool)
+            krows = jax.vmap(flatten_state, (0, None))(kstates, dims)
             enq = new & cons_ok
-            pos = next_count + jnp.cumsum(enq.astype(_I32)) - 1
-            pos = jnp.where(enq, pos, Q)
-            qnext = qnext.at[pos].set(crows, mode="drop")
+            epos = next_count + jnp.cumsum(enq.astype(_I32)) - 1
+            epos = jnp.where(enq, epos, Q + jnp.arange(K, dtype=_I32))
+            qnext = qnext.at[epos].set(krows)
             next_count = next_count + jnp.sum(enq, dtype=_I32)
 
             if record_static:
                 php, plp = jax.vmap(fingerprint)(states)  # parent fps [B]
-                k_idx = jnp.arange(B * G, dtype=_I32)
-                parent_hi = php[k_idx // G]
-                parent_lo = plp[k_idx // G]
-                actions = k_idx % G
+                parent_hi = php[lane_id // G]
+                parent_lo = plp[lane_id // G]
+                actions = lane_id % G
                 tpos = jnp.where(
-                    new, tcount + jnp.cumsum(new.astype(_I32)) - 1, TQ)
+                    new, tcount + jnp.cumsum(new.astype(_I32)) - 1,
+                    TQ + jnp.arange(K, dtype=_I32))
                 tbuf = tuple(
-                    buf.at[tpos].set(col, mode="drop")
+                    buf.at[tpos].set(col)
                     for buf, col in zip(
-                        tbuf, (fph, fpl, parent_hi, parent_lo, actions)))
+                        tbuf, (kh, kl, parent_hi, parent_lo, actions)))
                 tcount = tcount + jnp.sum(new, dtype=_I32)
 
             take_v = ~viol_any & viol_any_b
             vinv = jnp.where(take_v, inv[vpos], vinv)
-            vrow = jnp.where(take_v, crows[vpos], vrow)
-            vhi = jnp.where(take_v, fph[vpos], vhi)
-            vlo = jnp.where(take_v, fpl[vpos], vlo)
+            vrow = jnp.where(take_v, krows[vpos], vrow)
+            vhi = jnp.where(take_v, kh[vpos], vhi)
+            vlo = jnp.where(take_v, kl[vpos], vlo)
             drow = jnp.where(dead_any | ~dead_any_b, drow, drow_b)
-            return (offset + B, steps + 1, qnext, next_count, seen, tbuf,
-                    tcount, gen + jnp.sum(en, dtype=_I32),
+            return (offset + P, steps + 1, qnext, next_count, seen, tbuf,
+                    tcount, gen + total,
                     newc + jnp.sum(new, dtype=_I32),
                     ovfc + jnp.sum(ovf, dtype=_I32),
                     dead_any | dead_any_b, drow,
@@ -404,7 +443,7 @@ class BFSEngine:
                     stop = stop | dead_any
                 cont = more & qroom & sroom & ~stop
                 if record_static:
-                    cont = cont & (tcount <= TQ - B * G)
+                    cont = cont & (tcount <= TQ - K)
                 return cont
 
             out = jax.lax.while_loop(
@@ -431,6 +470,9 @@ class BFSEngine:
         self._root_check = (build_root_check(inv_fns, fingerprint)
                             if inv_fns else None)
         self._TQ = TQ
+        # Allocated trace rows: live region + K trash slots for the
+        # masked-off scatter lanes (stub when tracing is off).
+        self._TA = TQ + K if record_static else 8
 
     # ------------------------------------------------------------------
     def run(self, init_states: Optional[List[PyState]] = None,
@@ -476,9 +518,21 @@ class BFSEngine:
             for e in encoded:
                 check_packable(e)
             rows_np = np.stack([flatten_state(e, dims) for e in encoded])
+            # Root fingerprints for the trace store — computed (and their
+            # program compiled) BEFORE the duration clock starts; root
+            # registration is setup, like the warm-up below.
+            if cfg.record_trace:
+                rhi, rlo = (np.asarray(x) for x in
+                            self._fp_rows(jnp.asarray(rows_np)))
+                for idx, s in enumerate(init_states):
+                    fp = (int(rhi[idx]) << 32) | int(rlo[idx])
+                    trace.roots.setdefault(fp, s)
 
-        qcur = jnp.zeros((Q, sw), jnp.uint8)
-        qnext = jnp.zeros((Q, sw), jnp.uint8)
+        # Queues carry PAD rows past Q: slice overrun + scatter trash
+        # (see the capacity comment in __init__).
+        QA = Q + self._PAD
+        qcur = jnp.zeros((QA, sw), jnp.uint8)
+        qnext = jnp.zeros((QA, sw), jnp.uint8)
         seen = fpset.empty(self._seen_cap)
         next_count = jnp.int32(0)
         # Host-resident level segments: the part of the current level that
@@ -487,10 +541,10 @@ class BFSEngine:
         # state queue, in host RAM.
         pending: List[np.ndarray] = []
         spill_next: List[np.ndarray] = []
-        TQ = self._TQ
-        tbuf = (jnp.zeros((TQ,), jnp.uint32), jnp.zeros((TQ,), jnp.uint32),
-                jnp.zeros((TQ,), jnp.uint32), jnp.zeros((TQ,), jnp.uint32),
-                jnp.zeros((TQ,), _I32))
+        TA = self._TA
+        tbuf = (jnp.zeros((TA,), jnp.uint32), jnp.zeros((TA,), jnp.uint32),
+                jnp.zeros((TA,), jnp.uint32), jnp.zeros((TA,), jnp.uint32),
+                jnp.zeros((TA,), _I32))
 
         # Warm-up: run both programs once with empty inputs (no semantic
         # effect: all-invalid masks insert nothing, zero-trip chunk) so XLA
@@ -522,7 +576,7 @@ class BFSEngine:
             # rows + host segments (same split the spill path produces).
             pending = [fr[i:i + Q] for i in range(Q, len(fr), Q)]
             fr = fr[:Q]
-            qcur = jnp.zeros((Q, sw), jnp.uint8).at[:len(fr)].set(
+            qcur = jnp.zeros((QA, sw), jnp.uint8).at[:len(fr)].set(
                 jnp.asarray(fr))
             cur_count = len(fr)
             res.distinct = resume.distinct
@@ -551,13 +605,8 @@ class BFSEngine:
                     "for any later trace-on resume; use a different "
                     "checkpoint_dir or keep tracing enabled")
         else:
-            # Ingest initial states in B-sized chunks; register trace roots.
-            if cfg.record_trace:
-                rhi, rlo = (np.asarray(x) for x in
-                            self._fp_rows(jnp.asarray(rows_np)))
-                for idx, s in enumerate(init_states):
-                    fp = (int(rhi[idx]) << 32) | int(rlo[idx])
-                    trace.roots.setdefault(fp, s)
+            # Ingest initial states in B-sized chunks (roots registered
+            # above, before the clock).
             for base in range(0, len(rows_np), B):
                 chunk = rows_np[base:base + B]
                 pad = np.zeros((B - len(chunk), sw), ROW_DTYPE)
@@ -572,7 +621,9 @@ class BFSEngine:
                     raise RuntimeError(
                         "seen-set probe failure during ingest; raise "
                         "seen_capacity")
-                seen = self._maybe_grow_seen(seen, int(seen.size))
+                seen, qnext, tbuf, t0 = self._grow_precompiled(
+                    seen, int(seen.size), qcur, qnext, int(next_count),
+                    tbuf, t0)
                 nc = int(next_count)
                 if nc > self._QTH:      # spill: ingest adds <= B per call,
                     spill_next.append(  # so the watermark is never blown
@@ -641,8 +692,15 @@ class BFSEngine:
                     st = np.asarray(out[3])
                     if int(st[1]):       # st fetch synced: timing is real
                         per = (time.time() - t_call) / int(st[1])
-                        self._batch_ema = (per if not self._batch_ema else
-                                           0.5 * self._batch_ema + 0.5 * per)
+                        # Conservative estimator: jumps up to the latest
+                        # cost instantly, decays slowly — per-batch cost
+                        # grows with level depth (fuller probe chains,
+                        # busier frontiers), and an under-estimate lets
+                        # one deadline-sized chunk call overshoot the
+                        # duration budget by the whole error factor.
+                        self._batch_ema = (
+                            per if not self._batch_ema else
+                            max(per, 0.5 * self._batch_ema + 0.5 * per))
                     offset, next_count_h = int(st[0]), int(st[2])
                     seen_size, tcount = int(st[3]), int(st[4])
                     n_gen, n_new, n_ovf = int(st[5]), int(st[6]), int(st[7])
@@ -663,7 +721,9 @@ class BFSEngine:
                             "seen-set probe failure (load spiked past the "
                             "growth threshold within one chunk); raise "
                             "seen_capacity or lower sync_every")
-                    seen = self._maybe_grow_seen(seen, seen_size)
+                    seen, qnext, tbuf, t0 = self._grow_precompiled(
+                        seen, seen_size, qcur, qnext, next_count_h, tbuf,
+                        t0)
                     if next_count_h > self._QTH \
                             and (offset < cur_count or pending):
                         # Next-level queue at the watermark with more of
@@ -691,7 +751,7 @@ class BFSEngine:
                     break
                 # Upload the next host segment of this level.
                 seg = pending.pop(0)
-                buf = np.zeros((Q, sw), ROW_DTYPE)
+                buf = np.zeros((QA, sw), ROW_DTYPE)
                 buf[:len(seg)] = seg
                 qcur = jax.device_put(buf, qcur.devices().pop())
                 cur_count = len(seg)
@@ -751,6 +811,24 @@ class BFSEngine:
         return out
 
     # ------------------------------------------------------------------
+    def _grow_precompiled(self, seen, size, qcur, qnext, next_count, tbuf,
+                          t0):
+        """Grow the seen set when loaded past threshold, pre-compile the
+        chunk program at the new table shape with a zero-trip call, and
+        keep the rehash + compile off the duration clock — the StopAfter
+        budget measures checking time, not compilation (same rule as the
+        warm-up).  Returns (seen, qnext, tbuf, t0)."""
+        t_grow = time.time()
+        grown = self._maybe_grow_seen(seen, size)
+        if grown is not seen:
+            seen = grown
+            out = self._chunk(qcur, jnp.int32(0), jnp.int32(0), qnext,
+                              jnp.int32(next_count), seen, tbuf,
+                              jnp.int32(0), jnp.int32(1))
+            qnext, seen, tbuf = out[0], out[1], out[2]
+            t0 += time.time() - t_grow
+        return seen, qnext, tbuf, t0
+
     def _maybe_grow_seen(self, seen, size=None):
         """Double the FPSet (rehash through host keys) once load passes
         0.5 — early enough that the insertions of the next chunk (checked

@@ -41,6 +41,34 @@ def _sel(cond, then_tree, else_tree):
                         else_tree)
 
 
+# Point updates are written as arange-mask selects, NOT ``.at[i].set``:
+# with a traced index under the (instance x frontier) double vmap the
+# latter lowers to one scatter per field per action — hundreds of tiny
+# serializing scatters per batch on TPU — while a mask select lowers to
+# pure elementwise VPU code that XLA fuses across the whole where-cascade.
+
+def _set1(arr, i, v):
+    """arr[i] = v for a 1-D field ([N] or [M])."""
+    return jnp.where(jnp.arange(arr.shape[0]) == i, v, arr)
+
+
+def _add1(arr, i, d):
+    return jnp.where(jnp.arange(arr.shape[0]) == i, arr + d, arr)
+
+
+def _setrow(arr, i, row):
+    """arr[i, :] = row for a 2-D field ([N,N] or [M,W])."""
+    return jnp.where((jnp.arange(arr.shape[0]) == i)[:, None],
+                     row[None, :], arr)
+
+
+def _set2(arr, i, k, v):
+    """arr[i, k] = v for a 2-D field ([N,L] or [N,N])."""
+    mask = (jnp.arange(arr.shape[0]) == i)[:, None] \
+        & (jnp.arange(arr.shape[1]) == k)[None, :]
+    return jnp.where(mask, v, arr)
+
+
 def build_expand(dims: RaftDims):
     """Returns ``expand(state) -> (cands, enabled, overflow)`` where
     ``cands`` stacks ``dims.n_instances`` candidate successors."""
@@ -68,15 +96,15 @@ def build_expand(dims: RaftDims):
         idx = jnp.where(has_eq, jnp.argmax(eq), jnp.argmax(free))
         row = jnp.where(has_eq | ~ok, st.msg[idx], mvec)
         return st._replace(
-            msg=st.msg.at[idx].set(row),
-            msg_cnt=st.msg_cnt.at[idx].add(jnp.where(ok, 1, 0))), ok
+            msg=_setrow(st.msg, idx, row),
+            msg_cnt=_add1(st.msg_cnt, idx, jnp.where(ok, 1, 0))), ok
 
     def bag_discard_slot(st: StateBatch, s):
         """Discard one copy of the message in slot s — raft.tla:99.  Zeroes
         the row when the count hits 0 (canonical free slot)."""
-        new_cnt = st.msg_cnt.at[s].add(-1)
+        new_cnt = _add1(st.msg_cnt, s, -1)
         row = jnp.where(new_cnt[s] > 0, st.msg[s], jnp.zeros((W,), i32))
-        return st._replace(msg=st.msg.at[s].set(row), msg_cnt=new_cnt)
+        return st._replace(msg=_setrow(st.msg, s, row), msg_cnt=new_cnt)
 
     def reply_slot(st: StateBatch, resp, s):
         """Reply(resp, m@slot s) — raft.tla:102-103 (atomic discard+send)."""
@@ -91,23 +119,23 @@ def build_expand(dims: RaftDims):
     def restart(st: StateBatch, i):
         """Restart(i) — raft.tla:136-143."""
         new = st._replace(
-            role=st.role.at[i].set(FOLLOWER),
-            votes_resp=st.votes_resp.at[i].set(0),
-            votes_gran=st.votes_gran.at[i].set(0),
-            next_idx=st.next_idx.at[i].set(jnp.ones((N,), i32)),
-            match_idx=st.match_idx.at[i].set(jnp.zeros((N,), i32)),
-            commit=st.commit.at[i].set(0))
+            role=_set1(st.role, i, FOLLOWER),
+            votes_resp=_set1(st.votes_resp, i, 0),
+            votes_gran=_set1(st.votes_gran, i, 0),
+            next_idx=_setrow(st.next_idx, i, jnp.ones((N,), i32)),
+            match_idx=_setrow(st.match_idx, i, jnp.zeros((N,), i32)),
+            commit=_set1(st.commit, i, 0))
         return _TRUE, _FALSE, new
 
     def timeout(st: StateBatch, i):
         """Timeout(i) — raft.tla:146-154 (no self-vote)."""
         en = (st.role[i] == FOLLOWER) | (st.role[i] == CANDIDATE)
         new = st._replace(
-            role=st.role.at[i].set(CANDIDATE),
-            term=st.term.at[i].add(1),
-            voted_for=st.voted_for.at[i].set(NIL),
-            votes_resp=st.votes_resp.at[i].set(0),
-            votes_gran=st.votes_gran.at[i].set(0))
+            role=_set1(st.role, i, CANDIDATE),
+            term=_add1(st.term, i, 1),
+            voted_for=_set1(st.voted_for, i, NIL),
+            votes_resp=_set1(st.votes_resp, i, 0),
+            votes_gran=_set1(st.votes_gran, i, 0))
         return en, _FALSE, new
 
     def request_vote(st: StateBatch, i, j):
@@ -145,10 +173,11 @@ def build_expand(dims: RaftDims):
         member = ((st.votes_gran[i] >> jnp.arange(N, dtype=i32)) & 1) > 0
         en = (st.role[i] == CANDIDATE) & quorum(st, i, member)
         new = st._replace(
-            role=st.role.at[i].set(LEADER),
-            next_idx=st.next_idx.at[i].set(
+            role=_set1(st.role, i, LEADER),
+            next_idx=_setrow(
+                st.next_idx, i,
                 jnp.broadcast_to(st.log_len[i] + 1, (N,)).astype(i32)),
-            match_idx=st.match_idx.at[i].set(jnp.zeros((N,), i32)))
+            match_idx=_setrow(st.match_idx, i, jnp.zeros((N,), i32)))
         return en, _FALSE, new
 
     def client_request(st: StateBatch, i, v):
@@ -158,9 +187,9 @@ def build_expand(dims: RaftDims):
         fits = ln < L
         k = jnp.clip(ln, 0, L - 1)
         new = st._replace(
-            log_term=st.log_term.at[i, k].set(st.term[i]),
-            log_val=st.log_val.at[i, k].set(v),
-            log_len=st.log_len.at[i].add(1))
+            log_term=_set2(st.log_term, i, k, st.term[i]),
+            log_val=_set2(st.log_val, i, k, v),
+            log_len=_add1(st.log_len, i, 1))
         return is_leader & fits, is_leader & ~fits, new
 
     def advance_commit(st: StateBatch, i):
@@ -178,7 +207,7 @@ def build_expand(dims: RaftDims):
         own_term = st.log_term[i, jnp.clip(max_agree - 1, 0, L - 1)] \
             == st.term[i]
         new_commit = jnp.where(any_ok & own_term, max_agree, st.commit[i])
-        return en, _FALSE, st._replace(commit=st.commit.at[i].set(new_commit))
+        return en, _FALSE, st._replace(commit=_set1(st.commit, i, new_commit))
 
     # -- Receive(m) (raft.tla:388-403) ------------------------------------
     def receive(st: StateBatch, s):
@@ -199,9 +228,9 @@ def build_expand(dims: RaftDims):
 
         # UpdateTerm — raft.tla:373-379; message left in flight (:378).
         en_ut = occ & (mterm > t_i)
-        st_ut = st._replace(term=st.term.at[i].set(mterm),
-                            role=st.role.at[i].set(FOLLOWER),
-                            voted_for=st.voted_for.at[i].set(NIL))
+        st_ut = st._replace(term=_set1(st.term, i, mterm),
+                            role=_set1(st.role, i, FOLLOWER),
+                            voted_for=_set1(st.voted_for, i, NIL))
 
         le = occ & (mterm <= t_i)
 
@@ -218,7 +247,7 @@ def build_expand(dims: RaftDims):
                                                 (6 + L,))
         st_rvq = st._replace(
             voted_for=jnp.where(grant,
-                                st.voted_for.at[i].set(j + 1), st.voted_for))
+                                _set1(st.voted_for, i, j + 1), st.voted_for))
         st_rvq, rvq_ok = reply_slot(st_rvq, rvr_resp, s)
         en_rvq = le & (mtype == RVQ)
 
@@ -227,9 +256,10 @@ def build_expand(dims: RaftDims):
         en_rvr = le & (mtype == RVR) & (mterm == t_i)
         st_rvr = bag_discard_slot(
             st._replace(
-                votes_resp=st.votes_resp.at[i].set(
-                    st.votes_resp[i] | (1 << j)),
-                votes_gran=st.votes_gran.at[i].set(
+                votes_resp=_set1(st.votes_resp, i,
+                                 st.votes_resp[i] | (1 << j)),
+                votes_gran=_set1(
+                    st.votes_gran, i,
                     st.votes_gran[i] | (jnp.where(mvec[4] > 0, 1, 0) << j))),
             s)
 
@@ -248,7 +278,7 @@ def build_expand(dims: RaftDims):
         st_rej, rej_ok = reply_slot(st, rej_resp, s)
         # ReturnToFollowerState — :295-299 (message not consumed).
         en_rtf = en_aeq & (mterm == t_i) & (role_i == CANDIDATE)
-        st_rtf = st._replace(role=st.role.at[i].set(FOLLOWER))
+        st_rtf = st._replace(role=_set1(st.role, i, FOLLOWER))
         # Accept — :333-341, index == mprevLogIndex + 1.
         acc = en_aeq & (mterm == t_i) & (role_i == FOLLOWER) & aeq_logok
         index = prev + 1
@@ -264,17 +294,17 @@ def build_expand(dims: RaftDims):
         en_conf = acc & (n_ent > 0) & have_at & (term_at != eterm)
         k_last = jnp.clip(ln - 1, 0, L - 1)
         st_conf = st._replace(
-            log_term=st.log_term.at[i, k_last].set(0),
-            log_val=st.log_val.at[i, k_last].set(0),
-            log_len=st.log_len.at[i].add(-1))
+            log_term=_set2(st.log_term, i, k_last, 0),
+            log_val=_set2(st.log_val, i, k_last, 0),
+            log_len=_add1(st.log_len, i, -1))
         # NoConflict — :327-331: append mentries[1].
         fits = ln < L
         en_noc = acc & (n_ent > 0) & (ln == prev)
         k_app = jnp.clip(ln, 0, L - 1)
         st_noc = st._replace(
-            log_term=st.log_term.at[i, k_app].set(eterm),
-            log_val=st.log_val.at[i, k_app].set(eval_),
-            log_len=st.log_len.at[i].add(1))
+            log_term=_set2(st.log_term, i, k_app, eterm),
+            log_val=_set2(st.log_val, i, k_app, eval_),
+            log_len=_add1(st.log_len, i, 1))
 
         # AppendEntriesResponse: DropStale :402 / Handle :360-370.
         en_aer_drop = le & (mtype == AER) & (mterm < t_i)
@@ -282,11 +312,12 @@ def build_expand(dims: RaftDims):
         succ, mmatch = mvec[4] > 0, mvec[5]
         st_aer = bag_discard_slot(
             st._replace(
-                next_idx=st.next_idx.at[i, j].set(
+                next_idx=_set2(
+                    st.next_idx, i, j,
                     jnp.where(succ, mmatch + 1,
                               jnp.maximum(st.next_idx[i, j] - 1, 1))),
-                match_idx=st.match_idx.at[i, j].set(
-                    jnp.where(succ, mmatch, st.match_idx[i, j]))),
+                match_idx=_set2(st.match_idx, i, j,
+                                jnp.where(succ, mmatch, st.match_idx[i, j]))),
             s)
 
         st_drop = bag_discard_slot(st, s)
@@ -310,7 +341,7 @@ def build_expand(dims: RaftDims):
         """DuplicateMessage — raft.tla:410-412 (bag count +1)."""
         occ = st.msg_cnt[s] > 0
         return occ, _FALSE, st._replace(
-            msg_cnt=st.msg_cnt.at[s].add(jnp.where(occ, 1, 0)))
+            msg_cnt=_add1(st.msg_cnt, s, jnp.where(occ, 1, 0)))
 
     def drop(st: StateBatch, s):
         """DropMessage — raft.tla:415-417 (bag count -1)."""

@@ -159,12 +159,13 @@ class ReconfigDims(RaftDims):
         i32 = jnp.int32
 
         def append_entry(st, i, val):
+            from .actions import _add1, _set2
             ln = st.log_len[i]
             kpos = jnp.clip(ln, 0, L - 1)
             return ln < L, st._replace(
-                log_term=st.log_term.at[i, kpos].set(st.term[i]),
-                log_val=st.log_val.at[i, kpos].set(val),
-                log_len=st.log_len.at[i].add(1))
+                log_term=_set2(st.log_term, i, kpos, st.term[i]),
+                log_val=_set2(st.log_val, i, kpos, val),
+                log_len=_add1(st.log_len, i, 1))
 
         def initiate(st, i, c):
             """Leader with a final config appends C_current,c."""

@@ -61,6 +61,7 @@ from ..models.pystate import PyState
 from ..models.schema import (ROW_DTYPE, build_pack_guard, check_packable,
                              decode_state, encode_state, flatten_state,
                              state_width, unflatten_state)
+from ..ops import compact as compact_mod
 from ..ops import fpset
 from ..ops.fingerprint import SENTINEL, build_fingerprint
 
@@ -90,7 +91,12 @@ class MeshBFSEngine:
         pack_ok = build_pack_guard(dims)
         sw = state_width(dims)
         B, G = cfg.batch, dims.n_instances
-        K = B * G
+        BG = B * G
+        # Compacted-candidate lanes per chip (ops/compact.py): only K
+        # lanes go through owner routing, the hash insert, row
+        # materialization, and enqueue — and only K fingerprints per chip
+        # cross the ICI per batch, not B*G.
+        K = compact_mod.choose_k(B, G, cfg.compact_lanes)
         self._check_deadlock = (True if cfg.check_deadlock is None
                                 else cfg.check_deadlock)
         # Per-chip capacities; None resolves through the same HBM
@@ -101,33 +107,43 @@ class MeshBFSEngine:
             auto_q, auto_s = _auto_capacities(sw, B, cfg.record_trace)
             qreq = auto_q if qreq is None else qreq
             sreq = auto_s if sreq is None else sreq
-        # Queue: batch-multiple, floored at one worst-case batch (B*G new
+        # Queue: batch-multiple, floored at one worst-case batch (K new
         # rows) — a batch can never overflow mid-chunk; the watermark
-        # below spills *between* batches (engine/bfs.py invariant).
+        # below spills *between* batches (engine/bfs.py invariant).  The
+        # allocation carries PAD extra rows: B of slice overrun + K of
+        # scatter trash (distinct per-lane addresses for masked-off
+        # enqueue lanes — ops/fpset.py design note 3).
         per_chip = -(-qreq // n)
         QL = max(-(-per_chip // B) * B, K)
-        # Seen shard: each chip receives up to B*G owner-routed queries per
-        # batch; the same 8-batch floor as the single-chip engine keeps the
-        # growth threshold (half load) safely ahead of probe failure.
+        PAD = max(B, K)
+        # Seen shard: each chip receives up to n*K owner-routed queries
+        # per batch in the worst case, but only ~K on average; the same
+        # 8-batch floor as the single-chip engine keeps the growth
+        # threshold (half load) safely ahead of probe failure.
         CL = fpset._capacity(max(-(-sreq // n), 8 * K))
         self._sw, self._B, self._G, self._QL, self._CL = sw, B, G, QL, CL
+        self._K, self._PAD = K, PAD
         self._QTH = QL - K
         CH = self._CH = max(1, cfg.sync_every)
         record_static = cfg.record_trace
         TQ = QL + K if record_static else 8
         self._TQ = TQ
+        self._TA = TQ + K if record_static else 8
         check_deadlock_static = self._check_deadlock
+        # pmin keeps every chip's offset advance identical — the chunk
+        # body contains collectives, so trip counts must agree.
+        compactor = compact_mod.build_compactor(
+            B, G, K, reduce_p=lambda p: jax.lax.pmin(p, "x"))
 
-        def local_absorb(crows, cands, en, parent_hi, parent_lo, actions,
-                         qnext, next_count, seen_local, tbuf, tcount):
-            """Per-chip tail with cross-chip owner dedup.  All arrays are
-            this chip's shard (no leading device axis)."""
-            k = crows.shape[0]
-            fph, fpl = jax.vmap(fingerprint)(cands)
-            fph = jnp.where(en, fph, SENTINEL)
-            fpl = jnp.where(en, fpl, SENTINEL)
-
-            # Route to owner = fp_hi mod n.
+        def route_insert(seen_local, fph, fpl, valid):
+            """Cross-chip owner dedup: route each valid fingerprint to its
+            owner chip (fp_hi mod n) with one all_to_all, insert the union
+            of arrivals into the local shard, route the novelty bits back.
+            Exactly one copy of each globally-new key (across all chips)
+            gets the bit."""
+            k = fph.shape[0]
+            fph = jnp.where(valid, fph, SENTINEL)
+            fpl = jnp.where(valid, fpl, SENTINEL)
             owner = (fph % _U32(n)).astype(_I32)
             perm = jnp.argsort(owner, stable=True)
             osort = owner[perm]
@@ -138,19 +154,25 @@ class MeshBFSEngine:
             bl = jnp.full((n, k), SENTINEL, _U32).at[osort, rank].set(q_lo)
             bh = jax.lax.all_to_all(bh, "x", 0, 0, tiled=True)
             bl = jax.lax.all_to_all(bl, "x", 0, 0, tiled=True)
-
-            # Owner side: one hash-table insert over the union of arriving
-            # queries — in-batch dedup and seen-set probe/update in one
-            # pass; exactly one arriving copy of each globally-new key gets
-            # the novelty bit.
             rh, rl = bh.reshape(-1), bl.reshape(-1)
             rvalid = ~((rh == SENTINEL) & (rl == SENTINEL))
             seen_local, qnew, fail = fpset.insert(seen_local, rh, rl, rvalid)
             nov = jax.lax.all_to_all(qnew.reshape(n, k), "x", 0, 0,
                                      tiled=True)
-            # Back on the origin chip: one novelty bit per local candidate.
             new_sortpos = nov[osort, rank]
             new = jnp.zeros((k,), bool).at[perm].set(new_sortpos)
+            return seen_local, new, fail
+
+        def local_absorb(crows, cands, en, parent_hi, parent_lo, actions,
+                         qnext, next_count, seen_local, tbuf, tcount):
+            """Per-chip tail with cross-chip owner dedup.  All arrays are
+            this chip's shard (no leading device axis).  Ingest-sized (k
+            <= B); the chunk path below compacts first."""
+            k = crows.shape[0]
+            fph, fpl = jax.vmap(fingerprint)(cands)
+            seen_local, new, fail = route_insert(seen_local, fph, fpl, en)
+            fph = jnp.where(en, fph, SENTINEL)
+            fpl = jnp.where(en, fpl, SENTINEL)
 
             n_new = jnp.sum(new, dtype=_I32)      # local share of global new
 
@@ -196,34 +218,67 @@ class MeshBFSEngine:
             en = en & valid[:, None]
             ovf = (ovf | (en & ~jax.vmap(jax.vmap(pack_ok))(cands))) \
                 & valid[:, None]
-            dead_b = valid & ~jnp.any(en, axis=1) & ~jnp.any(ovf, axis=1)
+
+            # Progress limiting + lane compaction (ops/compact.py; P is
+            # pmin-replicated via the compactor's reduce_p hook).
+            P, total, lane_id, kvalid = compactor(en)
+            ptaken = jnp.arange(B, dtype=_I32) < P
+            en = en & ptaken[:, None]
+            ovf = ovf & ptaken[:, None]
+            dead_b = valid & ptaken & ~jnp.any(en, axis=1) \
+                & ~jnp.any(ovf, axis=1)
             dead_any_b = jnp.any(dead_b)
             drow_b = rows[jnp.argmax(dead_b)]
 
             cflat = jax.tree.map(
-                lambda a: a.reshape((K,) + a.shape[2:]), cands)
-            crows = jax.vmap(flatten_state, (0, None))(cflat, dims)
+                lambda a: a.reshape((BG,) + a.shape[2:]), cands)
+            fph, fpl = jax.vmap(fingerprint)(cflat)
+            kh, kl = fph[lane_id], fpl[lane_id]
+
+            seen_l, new, fail = route_insert(seen_l, kh, kl, kvalid)
+            n_new = jnp.sum(new, dtype=_I32)
+
+            kstates = jax.tree.map(lambda a: a[lane_id], cflat)
+            if inv_fns:
+                inv = jax.vmap(build_inv_id(inv_fns))(kstates)
+            else:
+                inv = jnp.full((K,), -1, _I32)
+            viol = new & (inv >= 0)
+            viol_any_b = jnp.any(viol)
+            vpos = jnp.argmax(viol)
+
+            if constraint is not None:
+                cons_ok = jax.vmap(constraint)(kstates)
+            else:
+                cons_ok = jnp.ones((K,), bool)
+            krows = jax.vmap(flatten_state, (0, None))(kstates, dims)
+            enq = new & cons_ok
+            epos = ncnt_l + jnp.cumsum(enq.astype(_I32)) - 1
+            epos = jnp.where(enq, epos, QL + jnp.arange(K, dtype=_I32))
+            qnext_l = qnext_l.at[epos].set(krows)
+            ncnt_l = ncnt_l + jnp.sum(enq, dtype=_I32)
+
             if record_static:
                 php, plp = jax.vmap(fingerprint)(states)
-                k_idx = jnp.arange(K, dtype=_I32)
-                parent_hi, parent_lo = php[k_idx // G], plp[k_idx // G]
-                actions = k_idx % G
-            else:
-                parent_hi = parent_lo = jnp.zeros((K,), _U32)
-                actions = jnp.full((K,), -1, _I32)
-            (qnext_l, ncnt_l, seen_l, tbuf_l, tcnt_l, n_new, fail,
-             vinfo) = local_absorb(
-                crows, cflat, en.reshape(-1), parent_hi, parent_lo,
-                actions, qnext_l, ncnt_l, seen_l, tbuf_l, tcnt_l)
-            viol_any_b, inv_b, vrow_b, vhi_b, vlo_b = vinfo
+                parent_hi, parent_lo = php[lane_id // G], plp[lane_id // G]
+                actions = lane_id % G
+                tpos = jnp.where(
+                    new, tcnt_l + jnp.cumsum(new.astype(_I32)) - 1,
+                    TQ + jnp.arange(K, dtype=_I32))
+                tbuf_l = tuple(
+                    buf.at[tpos].set(col)
+                    for buf, col in zip(
+                        tbuf_l, (kh, kl, parent_hi, parent_lo, actions)))
+                tcnt_l = tcnt_l + n_new
+
             take_v = ~viol_any & viol_any_b
-            vinv = jnp.where(take_v, inv_b, vinv)
-            vrow = jnp.where(take_v, vrow_b, vrow)
-            vhi = jnp.where(take_v, vhi_b, vhi)
-            vlo = jnp.where(take_v, vlo_b, vlo)
+            vinv = jnp.where(take_v, inv[vpos], vinv)
+            vrow = jnp.where(take_v, krows[vpos], vrow)
+            vhi = jnp.where(take_v, kh[vpos], vhi)
+            vlo = jnp.where(take_v, kl[vpos], vlo)
             drow = jnp.where(dead_any | ~dead_any_b, drow, drow_b)
-            return (offset + B, steps + 1, qnext_l, ncnt_l, seen_l, tbuf_l,
-                    tcnt_l, gen + jnp.sum(en, dtype=_I32), newc + n_new,
+            return (offset + P, steps + 1, qnext_l, ncnt_l, seen_l, tbuf_l,
+                    tcnt_l, gen + total, newc + n_new,
                     ovfc + jnp.sum(ovf, dtype=_I32),
                     dead_any | dead_any_b, drow,
                     viol_any | viol_any_b, vinv, vrow, vhi, vlo,
@@ -328,10 +383,10 @@ class MeshBFSEngine:
 
     # ------------------------------------------------------------------
     def _empty_tbuf(self):
-        n, TQ = self.n_dev, self._TQ
-        return (jnp.zeros((n, TQ), jnp.uint32), jnp.zeros((n, TQ), jnp.uint32),
-                jnp.zeros((n, TQ), jnp.uint32), jnp.zeros((n, TQ), jnp.uint32),
-                jnp.zeros((n, TQ), _I32))
+        n, TA = self.n_dev, self._TA
+        return (jnp.zeros((n, TA), jnp.uint32), jnp.zeros((n, TA), jnp.uint32),
+                jnp.zeros((n, TA), jnp.uint32), jnp.zeros((n, TA), jnp.uint32),
+                jnp.zeros((n, TA), _I32))
 
     def _grow_seen(self, shi, slo, ssize, new_cl=None):
         """Rebuild every shard at double (or given) capacity.  Owner
@@ -392,8 +447,9 @@ class MeshBFSEngine:
                 self._rebuild_programs()
 
         CL = self._CL
-        qcur = jnp.zeros((n, QL, sw), jnp.uint8)
-        qnext = jnp.zeros((n, QL, sw), jnp.uint8)
+        QLA = QL + self._PAD     # live rows + slice-overrun/scatter trash
+        qcur = jnp.zeros((n, QLA, sw), jnp.uint8)
+        qnext = jnp.zeros((n, QLA, sw), jnp.uint8)
         shi = jnp.full((n, CL), SENTINEL, _U32)
         slo = jnp.full((n, CL), SENTINEL, _U32)
         ssize = jnp.zeros((n,), _I32)
@@ -496,7 +552,9 @@ class MeshBFSEngine:
                                        "ingest; raise seen_capacity")
                 self._flush_trace(trace, tbuf, tcount)
                 tcount = jnp.zeros((n,), _I32)
-                shi, slo, ssize = self._maybe_grow(shi, slo, ssize)
+                (shi, slo, ssize, qnext, next_counts, tbuf,
+                 t0) = self._grow_precompiled(shi, slo, ssize, qcur, qnext,
+                                              next_counts, tbuf, tcount, t0)
                 nc = np.asarray(next_counts)
                 if int(nc.max()) > self._QTH:   # ingest adds <= B per wave
                     spill_next.append(self._drain(qnext, nc))
@@ -555,9 +613,11 @@ class MeshBFSEngine:
                     lc = np.asarray(local)
                     if int(st[1]):
                         per = (time.time() - t_call) / int(st[1])
+                        # Conservative: jump up instantly, decay slowly
+                        # (engine/bfs.py rationale).
                         self._batch_ema = (
                             per if not self._batch_ema else
-                            0.5 * self._batch_ema + 0.5 * per)
+                            max(per, 0.5 * self._batch_ema + 0.5 * per))
                     offset = int(st[0])
                     res.generated += int(st[2])
                     res.distinct += int(st[3])
@@ -574,7 +634,10 @@ class MeshBFSEngine:
                             "sync_every")
                     self._flush_trace(trace, tbuf, tcount)
                     tcount = jnp.zeros((n,), _I32)
-                    shi, slo, ssize = self._maybe_grow(shi, slo, ssize)
+                    (shi, slo, ssize, qnext, next_counts, tbuf,
+                     t0) = self._grow_precompiled(
+                        shi, slo, ssize, qcur, qnext, next_counts, tbuf,
+                        tcount, t0)
                     ncnt = lc[:, 0]
                     if int(ncnt.max()) > self._QTH \
                             and (offset < max_count or pending):
@@ -605,7 +668,7 @@ class MeshBFSEngine:
                 while len(seg) > n * QL:
                     pending.insert(0, seg[n * QL:])
                     seg = seg[:n * QL]
-                buf = np.zeros((n, QL, sw), ROW_DTYPE)
+                buf = np.zeros((n, QLA, sw), ROW_DTYPE)
                 cur_counts = np.zeros((n,), np.int64)
                 share = -(-len(seg) // n)
                 for d in range(n):
@@ -640,6 +703,24 @@ class MeshBFSEngine:
         if int(np.asarray(ssize).max()) <= self._CL // 2:
             return shi, slo, ssize
         return self._grow_seen(shi, slo, ssize)
+
+    def _grow_precompiled(self, shi, slo, ssize, qcur, qnext, next_counts,
+                          tbuf, tcount, t0):
+        """Grow the seen shards when loaded past threshold, pre-compile
+        the rebuilt programs at the new shape with a zero-trip call, and
+        keep the rehash + compile off the duration clock (engine/bfs.py
+        rule).  Returns (shi, slo, ssize, qnext, next_counts, tbuf, t0)."""
+        t_grow = time.time()
+        grown = self._maybe_grow(shi, slo, ssize)
+        if grown[0] is not shi:
+            shi, slo, ssize = grown
+            out = self._chunk(
+                qcur, jnp.zeros((self.n_dev,), _I32), jnp.int32(0), qnext,
+                next_counts, shi, slo, ssize, tbuf, tcount,
+                jnp.int32(1), jnp.int32(0))
+            qnext, next_counts, shi, slo, ssize, tbuf = out[:6]
+            t0 += time.time() - t_grow
+        return shi, slo, ssize, qnext, next_counts, tbuf, t0
 
     def _write_checkpoint(self, qcur, cur_counts, pending, shi, slo, res,
                           trace, wall):

@@ -3,18 +3,17 @@ ambient platform (TPU under the driver; CPU anywhere).  This is the
 instrument for the round-3 performance work: run it before and after any
 engine change and commit the numbers.
 
-Parts timed (all jitted separately, block_until_ready between):
-  expand        rows -> candidate StateBatch [B,G] + enabled
-  flatten       candidates -> flat uint8 rows [B*G, SW]
-  fingerprint   rows -> (hi, lo) uint32 lanes
-  sort-dedup    the in-batch dedup sort over the padded batch
-  probe-insert  fpset.insert_unique on the DEDUPED keys (the real path;
-                raw keys would violate its distinct-keys precondition and
-                measure a duplicate-collision pathology production never pays)
-  full-insert   fpset.insert (sort + probes)
-  enqueue       cumsum + scatter of new rows into the next queue
-  CHUNK         the engine's real fused chunk program, 1 batch/call
-  CHUNK x8      ditto, 8 batches per call (sync_every amortization)
+Parts timed (all jitted separately, block_until_ready between), matching
+the compacted chunk pipeline in engine/bfs.py:
+
+  expand          rows -> candidate StateBatch [B,G] + enabled
+  fingerprint     expand + fingerprints for all B*G lanes
+  compact         expand + fp + prefix-sum compaction to K lanes
+  insert          fpset.insert on K compacted keys (sort + probe rounds)
+  materialize     gather K candidate states + flatten to uint8 rows
+  enqueue         scatter K rows into the next queue (trash-spread lanes)
+  CHUNK           the engine's real fused chunk program, 1 batch/call
+  CHUNK x8        ditto, 8 batches per call (sync_every amortization)
 
 Run:  python scripts/profile_step.py [batch]
 
@@ -37,11 +36,12 @@ import numpy as np
 from raft_tla_tpu.engine.bfs import EngineConfig
 from raft_tla_tpu.engine.check import initial_states, make_engine
 from raft_tla_tpu.models.actions import build_expand
-from raft_tla_tpu.models.schema import (flatten_state, unflatten_state,
-                                        encode_state)
+from raft_tla_tpu.models.schema import flatten_state, unflatten_state
 from raft_tla_tpu.ops import fpset
 from raft_tla_tpu.ops.fingerprint import build_fingerprint
 from raft_tla_tpu.utils.cfg import load_config
+
+_I32 = jnp.int32
 
 
 def bench(label, fn, *args, n=10, **kw):
@@ -67,8 +67,10 @@ def main():
                        seen_capacity=1 << 23, record_trace=False,
                        check_deadlock=False)
     eng = make_engine(setup, cfg)
-    G, SW, Q = eng._G, eng._sw, eng._Q
-    print(f"dims: {dims}  B={B} G={G} SW={SW} B*G={B*G}")
+    G, SW, Q, K = eng._G, eng._sw, eng._Q, eng._K
+    QA = Q + eng._PAD
+    BG = B * G
+    print(f"dims: {dims}  B={B} G={G} SW={SW} B*G={BG} K={K}")
 
     # A realistic frontier: run the engine for a few levels and snapshot a
     # mid-level frontier, so the benchmarked batch has representative
@@ -81,11 +83,13 @@ def main():
     wrows = warm._last_frontier
     print(f"warm-up frontier: {len(wrows)} states at diameter "
           f"{wres.diameter} ({wres.distinct} distinct seen)")
-    reps = -(-Q // len(wrows))
-    qcur = jnp.asarray(np.tile(wrows, (reps, 1))[:Q])
+    reps = -(-QA // len(wrows))
+    qcur = jnp.asarray(np.tile(wrows, (reps, 1))[:QA])
 
     expand = build_expand(dims)
     fingerprint = build_fingerprint(dims)
+    from raft_tla_tpu.ops.compact import build_compactor
+    compactor = build_compactor(B, G, K)
 
     @jax.jit
     def part_expand(rows):
@@ -94,58 +98,57 @@ def main():
         return jax.tree.map(lambda a: a.sum(), cands), en.sum()
 
     @jax.jit
-    def part_expand_flatten(rows):
+    def part_fp(rows):
         states = jax.vmap(unflatten_state, (0, None))(rows, dims)
         cands, en, ovf = jax.vmap(expand)(states)
         cflat = jax.tree.map(
-            lambda a: a.reshape((B * G,) + a.shape[2:]), cands)
-        crows = jax.vmap(flatten_state, (0, None))(cflat, dims)
-        return crows, en.reshape(-1)
+            lambda a: a.reshape((BG,) + a.shape[2:]), cands)
+        fph, fpl = jax.vmap(fingerprint)(cflat)
+        return fph.sum(), fpl.sum(), en.sum()
 
     @jax.jit
-    def part_fingerprint(crows):
-        cands = jax.vmap(unflatten_state, (0, None))(crows, dims)
-        return jax.vmap(fingerprint)(cands)
+    def part_compact(rows):
+        states = jax.vmap(unflatten_state, (0, None))(rows, dims)
+        cands, en, ovf = jax.vmap(expand)(states)
+        cflat = jax.tree.map(
+            lambda a: a.reshape((BG,) + a.shape[2:]), cands)
+        fph, fpl = jax.vmap(fingerprint)(cflat)
+        _P, _total, lane_id, kvalid = compactor(en)
+        return (cflat, fph[lane_id], fpl[lane_id], lane_id, kvalid)
 
     @jax.jit
-    def part_sort(fph, fpl, en):
-        (qh, ql, v), k = fpset._pad_pow2(
-            (fph, fpl, en), (fpset.SENTINEL, fpset.SENTINEL, False))
-        return fpset.dedup_batch(qh, ql, v)
+    def part_insert(seen, kh, kl, kvalid):
+        return fpset.insert(seen, kh, kl, kvalid)
 
     @jax.jit
-    def part_probes(seen, fph, fpl, en):
-        return fpset.insert_unique(seen, fph, fpl, en)
+    def part_materialize(cflat, lane_id):
+        kstates = jax.tree.map(lambda a: a[lane_id], cflat)
+        return jax.vmap(flatten_state, (0, None))(kstates, dims)
 
     @jax.jit
-    def part_insert(seen, fph, fpl, en):
-        return fpset.insert(seen, fph, fpl, en)
-
-    @jax.jit
-    def part_enqueue(qnext, next_count, crows, enq):
-        pos = next_count + jnp.cumsum(enq.astype(jnp.int32)) - 1
-        pos = jnp.where(enq, pos, Q)
-        qnext = qnext.at[pos].set(crows, mode="drop")
-        return qnext, next_count + jnp.sum(enq, dtype=jnp.int32)
+    def part_enqueue(qnext, next_count, krows, enq):
+        epos = next_count + jnp.cumsum(enq.astype(_I32)) - 1
+        epos = jnp.where(enq, epos, Q + jnp.arange(K, dtype=_I32))
+        qnext = qnext.at[epos].set(krows)
+        return qnext, next_count + jnp.sum(enq, dtype=_I32)
 
     rows = qcur[:B]
-    bench("expand (no flatten)", part_expand, rows)
-    _, (crows, en) = bench("expand + flatten", part_expand_flatten, rows)
-    _, (fph, fpl) = bench("fingerprint (on B*G rows)", part_fingerprint,
-                          crows)
-    _, ((sh, sl), _order, first) = bench("sort-dedup (padded batch)",
-                                         part_sort, fph, fpl, en)
+    bench("expand", part_expand, rows)
+    bench("expand + fingerprint (B*G)", part_fp, rows)
+    _, (cflat, kh, kl, lane_id, kvalid) = bench(
+        "expand + fp + compact (K lanes)", part_compact, rows)
     seen = fpset.empty(cfg.seen_capacity)
-    bench("probe-insert (32 rounds, deduped keys)", part_probes, seen, sh,
-          sl, first)
-    bench("full fpset.insert (sort + probes)", part_insert, seen, fph, fpl,
-          en)
-    qnext = jnp.zeros((Q, SW), jnp.uint8)
-    bench("enqueue scatter", part_enqueue, qnext, jnp.int32(0), crows, en)
+    bench("fpset.insert (K keys: sort + probes)", part_insert, seen, kh, kl,
+          kvalid)
+    _, krows = bench("materialize K rows (gather+flatten)",
+                     part_materialize, cflat, lane_id)
+    qnext = jnp.zeros((QA, SW), jnp.uint8)
+    bench("enqueue scatter (K rows)", part_enqueue, qnext, jnp.int32(0),
+          krows, kvalid)
 
     # The engine's own fused chunk program (qnext/seen/tbuf are donated:
     # thread the outputs back through).
-    tbuf = tuple(jnp.zeros((eng._TQ,), d) for d in
+    tbuf = tuple(jnp.zeros((eng._TA,), d) for d in
                  (jnp.uint32, jnp.uint32, jnp.uint32, jnp.uint32, jnp.int32))
 
     def chunk_once(qnext, seen, tbuf):

@@ -6,9 +6,12 @@ regression coverage:
 - scatters are value-neutral (identity-element combiners), never routed to
   a shared drop index — see the design notes in ops/fpset.py;
 - the claim table may be smaller than the key table (``CLAIM_CAP``), so
-  distinct slots can alias one claim entry; a per-round reset keeps an
-  alias eclipse to one round (without it, stale winner ids starve aliased
-  lanes into spurious ``fail``).
+  distinct slots can alias one claim entry; claims are round-tagged
+  (``r*kp + lane`` under a max combiner), so a round-r attempt always
+  supersedes any stale entry from an earlier round — no reset scatter,
+  and an alias can never eclipse a later round's attempt (without the
+  tags, stale winner ids would starve aliased lanes into spurious
+  ``fail``).
 
 The test forces the capped path with a tiny cap and checks exact set
 semantics against a Python set under heavy duplication across many batches.
