@@ -60,6 +60,7 @@ from ..models.schema import (ROW_DTYPE, StateBatch, build_pack_guard,
 from ..ops import compact as compact_mod
 from ..ops import fpset
 from ..ops.fingerprint import build_fingerprint
+from .chunk import build_chunk_body
 
 _I32 = jnp.int32
 
@@ -82,7 +83,8 @@ class EngineConfig:
     # the default of 16 lanes per frontier state loses nothing; when a
     # batch's fan-out does exceed K the device loop simply takes fewer
     # parents that step (progress-limited, never dropped).  None => auto
-    # (16*batch, clamped to [G, B*G], power of two).
+    # (16*batch); any value is floored at max(G, batch) and rounded to a
+    # power of two (ops/compact.py choose_k).
     compact_lanes: Optional[int] = None
     # None = defer to the cfg file (make_engine fills it in); a bool from
     # the caller always wins — the documented precedence chain.
@@ -219,7 +221,6 @@ class BFSEngine:
         pack_ok = build_pack_guard(dims)
         sw = state_width(dims)
         B, G = cfg.batch, dims.n_instances
-        BG = B * G
         # Compacted-candidate lanes (ops/compact.py owns the invariants).
         K = compact_mod.choose_k(B, G, cfg.compact_lanes)
         qreq, sreq = cfg.queue_capacity, cfg.seen_capacity
@@ -328,91 +329,13 @@ class BFSEngine:
         self._QTH = QTH
         compactor = compact_mod.build_compactor(B, G, K)
 
-        def chunk_body(qcur, cur_count, carry):
-            (offset, steps, qnext, next_count, seen, tbuf, tcount,
-             gen, newc, ovfc, dead_any, drow, viol_any, vinv, vrow,
-             vhi, vlo, fail_any) = carry
-            rows = jax.lax.dynamic_slice_in_dim(qcur, offset, B, axis=0)
-            valid = (offset + jnp.arange(B, dtype=_I32)) < cur_count
-            states = jax.vmap(unflatten_state, (0, None))(rows, dims)
-            cands, en, ovf = jax.vmap(expand)(states)
-            en = en & valid[:, None]
-            # A successor whose term/bag count outgrew the uint8 row is an
-            # overflow too (schema.build_pack_guard): stop, never alias.
-            ovf = (ovf | (en & ~jax.vmap(jax.vmap(pack_ok))(cands))) \
-                & valid[:, None]
-
-            # Progress limiting + lane compaction (ops/compact.py): take
-            # the longest parent prefix whose fan-out fits K, compact the
-            # enabled lanes to K slots — nothing is ever dropped, a
-            # fan-out burst just advances fewer parents this step.
-            P, total, lane_id, kvalid = compactor(en)
-            ptaken = jnp.arange(B, dtype=_I32) < P
-            en = en & ptaken[:, None]
-            ovf = ovf & ptaken[:, None]
-            dead_b = valid & ptaken & ~jnp.any(en, axis=1) \
-                & ~jnp.any(ovf, axis=1)
-            dead_any_b = jnp.any(dead_b)
-            drow_b = rows[jnp.argmax(dead_b)]
-
-            # Fingerprints for all B*G lanes, straight off the candidate
-            # structs (identical to hashing the packed rows whenever
-            # pack_ok holds — and any overflow aborts the run above).
-            cflat = jax.tree.map(
-                lambda a: a.reshape((BG,) + a.shape[2:]), cands)
-            fph, fpl = jax.vmap(fingerprint)(cflat)             # [BG]
-
-            kh, kl = fph[lane_id], fpl[lane_id]
-            seen, new, fail = fpset.insert(seen, kh, kl, kvalid)
-
-            # Everything below runs on the K compacted lanes only.
-            kstates = jax.tree.map(lambda a: a[lane_id], cflat)
-            if inv_fns:
-                inv = jax.vmap(build_inv_id(inv_fns))(kstates)
-            else:
-                inv = jnp.full((K,), -1, _I32)
-            viol = new & (inv >= 0)
-            viol_any_b = jnp.any(viol)
-            vpos = jnp.argmax(viol)
-
-            if constraint is not None:
-                cons_ok = jax.vmap(constraint)(kstates)
-            else:
-                cons_ok = jnp.ones((K,), bool)
-            krows = jax.vmap(flatten_state, (0, None))(kstates, dims)
-            enq = new & cons_ok
-            epos = next_count + jnp.cumsum(enq.astype(_I32)) - 1
-            epos = jnp.where(enq, epos, Q + jnp.arange(K, dtype=_I32))
-            qnext = qnext.at[epos].set(krows)
-            next_count = next_count + jnp.sum(enq, dtype=_I32)
-
-            if record_static:
-                php, plp = jax.vmap(fingerprint)(states)  # parent fps [B]
-                parent_hi = php[lane_id // G]
-                parent_lo = plp[lane_id // G]
-                actions = lane_id % G
-                tpos = jnp.where(
-                    new, tcount + jnp.cumsum(new.astype(_I32)) - 1,
-                    TQ + jnp.arange(K, dtype=_I32))
-                tbuf = tuple(
-                    buf.at[tpos].set(col)
-                    for buf, col in zip(
-                        tbuf, (kh, kl, parent_hi, parent_lo, actions)))
-                tcount = tcount + jnp.sum(new, dtype=_I32)
-
-            take_v = ~viol_any & viol_any_b
-            vinv = jnp.where(take_v, inv[vpos], vinv)
-            vrow = jnp.where(take_v, krows[vpos], vrow)
-            vhi = jnp.where(take_v, kh[vpos], vhi)
-            vlo = jnp.where(take_v, kl[vpos], vlo)
-            drow = jnp.where(dead_any | ~dead_any_b, drow, drow_b)
-            return (offset + P, steps + 1, qnext, next_count, seen, tbuf,
-                    tcount, gen + total,
-                    newc + jnp.sum(new, dtype=_I32),
-                    ovfc + jnp.sum(ovf, dtype=_I32),
-                    dead_any | dead_any_b, drow,
-                    viol_any | viol_any_b, vinv, vrow, vhi, vlo,
-                    fail_any | fail)
+        # The per-batch pipeline body is shared with the mesh engine
+        # (engine/chunk.py) — only the insert function differs.
+        chunk_body = build_chunk_body(
+            dims=dims, expand=expand, fingerprint=fingerprint,
+            pack_ok=pack_ok, inv_fns=inv_fns, constraint=constraint,
+            B=B, G=G, K=K, Q=Q, TQ=TQ, record_static=record_static,
+            compactor=compactor, insert_fn=fpset.insert)
 
         def chunk(qcur, cur_count, offset0, qnext, next_count, seen,
                   tbuf, tcount0, max_steps):

@@ -1,0 +1,135 @@
+"""The per-batch BFS pipeline body, shared by both engines.
+
+One batch = slice B rows off the level queue -> expand all G action
+instances -> fingerprint -> compact enabled lanes to K slots
+(ops/compact.py) -> hash-insert the K keys -> materialize rows, evaluate
+invariants + the state constraint, enqueue, record trace rows — all on the
+K compacted lanes.  engine/bfs.py (single chip) and parallel/mesh.py
+(sharded) run the IDENTICAL body; they differ only in
+
+- ``insert_fn``: the single-chip FPSet insert vs the mesh's owner-routed
+  all_to_all insert (mesh.py route_insert), and
+- the loop wrapper around the body (plain while_loop vs shard_map with
+  psum-replicated stop conditions), which stays in each engine.
+
+Keeping the body in one place is load-bearing: the two engines must stay
+bit-identical per batch (same candidate order, same compaction, same
+trace layout) for checkpoints to be portable across engines and for the
+differential tests to mean anything.
+
+The carry tuple layout (18 fields) is:
+    (offset, steps, qnext, next_count, seen, tbuf, tcount,
+     gen, newc, ovfc, dead_any, drow, viol_any, vinv, vrow, vhi, vlo,
+     fail_any)
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..models.invariants import build_inv_id
+from ..models.schema import flatten_state, unflatten_state
+
+_I32 = jnp.int32
+
+
+def build_chunk_body(*, dims, expand, fingerprint, pack_ok, inv_fns,
+                     constraint, B, G, K, Q, TQ, record_static, compactor,
+                     insert_fn):
+    """Returns ``chunk_body(qcur, cur_count, carry) -> carry'``.
+
+    ``Q`` is the live next-queue capacity (per chip for the mesh); masked
+    enqueue lanes write trash slots [Q, Q+K), masked trace lanes write
+    [TQ, TQ+K) — the caller allocates the padding (engine/bfs.py capacity
+    comment)."""
+    BG = B * G
+    inv_id = build_inv_id(inv_fns) if inv_fns else None
+
+    def chunk_body(qcur, cur_count, carry):
+        (offset, steps, qnext, next_count, seen, tbuf, tcount,
+         gen, newc, ovfc, dead_any, drow, viol_any, vinv, vrow,
+         vhi, vlo, fail_any) = carry
+        rows = jax.lax.dynamic_slice_in_dim(qcur, offset, B, axis=0)
+        valid = (offset + jnp.arange(B, dtype=_I32)) < cur_count
+        states = jax.vmap(unflatten_state, (0, None))(rows, dims)
+        cands, en, ovf = jax.vmap(expand)(states)
+        en = en & valid[:, None]
+        # A successor whose term/bag count outgrew the uint8 row is an
+        # overflow too (schema.build_pack_guard): stop, never alias.
+        ovf = (ovf | (en & ~jax.vmap(jax.vmap(pack_ok))(cands))) \
+            & valid[:, None]
+
+        # Progress limiting + lane compaction (ops/compact.py): take the
+        # longest parent prefix whose fan-out fits K, compact the enabled
+        # lanes to K slots — nothing is ever dropped, a fan-out burst
+        # just advances fewer parents this step.
+        P, total, lane_id, kvalid = compactor(en)
+        ptaken = jnp.arange(B, dtype=_I32) < P
+        en = en & ptaken[:, None]
+        ovf = ovf & ptaken[:, None]
+        dead_b = valid & ptaken & ~jnp.any(en, axis=1) \
+            & ~jnp.any(ovf, axis=1)
+        dead_any_b = jnp.any(dead_b)
+        drow_b = rows[jnp.argmax(dead_b)]
+
+        # Fingerprints for all B*G lanes, straight off the candidate
+        # structs (identical to hashing the packed rows whenever pack_ok
+        # holds — and any overflow aborts the run above).
+        cflat = jax.tree.map(
+            lambda a: a.reshape((BG,) + a.shape[2:]), cands)
+        fph, fpl = jax.vmap(fingerprint)(cflat)             # [BG]
+        kh, kl = fph[lane_id], fpl[lane_id]
+
+        seen, new, fail = insert_fn(seen, kh, kl, kvalid)
+
+        # Everything below runs on the K compacted lanes only.
+        kstates = jax.tree.map(lambda a: a[lane_id], cflat)
+        if inv_id is not None:
+            inv = jax.vmap(inv_id)(kstates)
+        else:
+            inv = jnp.full((K,), -1, _I32)
+        viol = new & (inv >= 0)
+        viol_any_b = jnp.any(viol)
+        vpos = jnp.argmax(viol)
+
+        if constraint is not None:
+            cons_ok = jax.vmap(constraint)(kstates)
+        else:
+            cons_ok = jnp.ones((K,), bool)
+        krows = jax.vmap(flatten_state, (0, None))(kstates, dims)
+        enq = new & cons_ok
+        epos = next_count + jnp.cumsum(enq.astype(_I32)) - 1
+        epos = jnp.where(enq, epos, Q + jnp.arange(K, dtype=_I32))
+        qnext = qnext.at[epos].set(krows)
+        next_count = next_count + jnp.sum(enq, dtype=_I32)
+
+        if record_static:
+            php, plp = jax.vmap(fingerprint)(states)  # parent fps [B]
+            parent_hi = php[lane_id // G]
+            parent_lo = plp[lane_id // G]
+            actions = lane_id % G
+            tpos = jnp.where(
+                new, tcount + jnp.cumsum(new.astype(_I32)) - 1,
+                TQ + jnp.arange(K, dtype=_I32))
+            tbuf = tuple(
+                buf.at[tpos].set(col)
+                for buf, col in zip(
+                    tbuf, (kh, kl, parent_hi, parent_lo, actions)))
+            tcount = tcount + jnp.sum(new, dtype=_I32)
+
+        take_v = ~viol_any & viol_any_b
+        vinv = jnp.where(take_v, inv[vpos], vinv)
+        vrow = jnp.where(take_v, krows[vpos], vrow)
+        vhi = jnp.where(take_v, kh[vpos], vhi)
+        vlo = jnp.where(take_v, kl[vpos], vlo)
+        drow = jnp.where(dead_any | ~dead_any_b, drow, drow_b)
+        return (offset + P, steps + 1, qnext, next_count, seen, tbuf,
+                tcount, gen + total,
+                newc + jnp.sum(new, dtype=_I32),
+                ovfc + jnp.sum(ovf, dtype=_I32),
+                dead_any | dead_any_b, drow,
+                viol_any | viol_any_b, vinv, vrow, vhi, vlo,
+                fail_any | fail)
+
+    return chunk_body

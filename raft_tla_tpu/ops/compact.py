@@ -31,12 +31,17 @@ _I32 = jnp.int32
 
 def choose_k(B: int, G: int, requested=None) -> int:
     """Compacted-lane count: the requested value (engine config) or the
-    16-lanes-per-parent default, floored at G and rounded to a power of
-    two."""
+    16-lanes-per-parent default, rounded to a power of two.
+
+    Floored at ``max(G, B)``: G so one parent's worst-case fan-out fits
+    (progress guarantee), and B because the engines' ingest path enqueues
+    up to B rows per call against a spill watermark of K — a smaller K
+    would let one ingest call run live rows into the scatter-trash region.
+    Capped at ``_pow2(B*G)``; more lanes than candidates is pure waste."""
     k = requested
     if k is None:
-        k = max(G, min(16 * B, B * G))
-    return fpset._pow2(max(k, G))
+        k = min(16 * B, B * G)
+    return min(fpset._pow2(max(k, G, B)), fpset._pow2(B * G))
 
 
 def build_compactor(B: int, G: int, K: int, reduce_p=None):

@@ -51,6 +51,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from ..engine.chunk import build_chunk_body
 from ..engine.bfs import (EngineConfig, EngineResult, TraceStore, Violation,
                           build_root_check, find_root_violation,
                           make_trace_store)
@@ -91,7 +92,6 @@ class MeshBFSEngine:
         pack_ok = build_pack_guard(dims)
         sw = state_width(dims)
         B, G = cfg.batch, dims.n_instances
-        BG = B * G
         # Compacted-candidate lanes per chip (ops/compact.py): only K
         # lanes go through owner routing, the hash insert, row
         # materialization, and enqueue — and only K fingerprints per chip
@@ -207,82 +207,15 @@ class MeshBFSEngine:
             return (qnext, next_count, seen_local, tbuf, tcount, n_new,
                     fail, vinfo)
 
-        def chunk_body(qcur_l, cur_count_l, carry):
-            (offset, steps, qnext_l, ncnt_l, seen_l, tbuf_l, tcnt_l,
-             gen, newc, ovfc, dead_any, drow, viol_any, vinv, vrow,
-             vhi, vlo, fail_any) = carry
-            rows = jax.lax.dynamic_slice_in_dim(qcur_l, offset, B, axis=0)
-            valid = (offset + jnp.arange(B, dtype=_I32)) < cur_count_l
-            states = jax.vmap(unflatten_state, (0, None))(rows, dims)
-            cands, en, ovf = jax.vmap(expand)(states)
-            en = en & valid[:, None]
-            ovf = (ovf | (en & ~jax.vmap(jax.vmap(pack_ok))(cands))) \
-                & valid[:, None]
-
-            # Progress limiting + lane compaction (ops/compact.py; P is
-            # pmin-replicated via the compactor's reduce_p hook).
-            P, total, lane_id, kvalid = compactor(en)
-            ptaken = jnp.arange(B, dtype=_I32) < P
-            en = en & ptaken[:, None]
-            ovf = ovf & ptaken[:, None]
-            dead_b = valid & ptaken & ~jnp.any(en, axis=1) \
-                & ~jnp.any(ovf, axis=1)
-            dead_any_b = jnp.any(dead_b)
-            drow_b = rows[jnp.argmax(dead_b)]
-
-            cflat = jax.tree.map(
-                lambda a: a.reshape((BG,) + a.shape[2:]), cands)
-            fph, fpl = jax.vmap(fingerprint)(cflat)
-            kh, kl = fph[lane_id], fpl[lane_id]
-
-            seen_l, new, fail = route_insert(seen_l, kh, kl, kvalid)
-            n_new = jnp.sum(new, dtype=_I32)
-
-            kstates = jax.tree.map(lambda a: a[lane_id], cflat)
-            if inv_fns:
-                inv = jax.vmap(build_inv_id(inv_fns))(kstates)
-            else:
-                inv = jnp.full((K,), -1, _I32)
-            viol = new & (inv >= 0)
-            viol_any_b = jnp.any(viol)
-            vpos = jnp.argmax(viol)
-
-            if constraint is not None:
-                cons_ok = jax.vmap(constraint)(kstates)
-            else:
-                cons_ok = jnp.ones((K,), bool)
-            krows = jax.vmap(flatten_state, (0, None))(kstates, dims)
-            enq = new & cons_ok
-            epos = ncnt_l + jnp.cumsum(enq.astype(_I32)) - 1
-            epos = jnp.where(enq, epos, QL + jnp.arange(K, dtype=_I32))
-            qnext_l = qnext_l.at[epos].set(krows)
-            ncnt_l = ncnt_l + jnp.sum(enq, dtype=_I32)
-
-            if record_static:
-                php, plp = jax.vmap(fingerprint)(states)
-                parent_hi, parent_lo = php[lane_id // G], plp[lane_id // G]
-                actions = lane_id % G
-                tpos = jnp.where(
-                    new, tcnt_l + jnp.cumsum(new.astype(_I32)) - 1,
-                    TQ + jnp.arange(K, dtype=_I32))
-                tbuf_l = tuple(
-                    buf.at[tpos].set(col)
-                    for buf, col in zip(
-                        tbuf_l, (kh, kl, parent_hi, parent_lo, actions)))
-                tcnt_l = tcnt_l + n_new
-
-            take_v = ~viol_any & viol_any_b
-            vinv = jnp.where(take_v, inv[vpos], vinv)
-            vrow = jnp.where(take_v, krows[vpos], vrow)
-            vhi = jnp.where(take_v, kh[vpos], vhi)
-            vlo = jnp.where(take_v, kl[vpos], vlo)
-            drow = jnp.where(dead_any | ~dead_any_b, drow, drow_b)
-            return (offset + P, steps + 1, qnext_l, ncnt_l, seen_l, tbuf_l,
-                    tcnt_l, gen + total, newc + n_new,
-                    ovfc + jnp.sum(ovf, dtype=_I32),
-                    dead_any | dead_any_b, drow,
-                    viol_any | viol_any_b, vinv, vrow, vhi, vlo,
-                    fail_any | fail)
+        # The per-batch pipeline body is shared with the single-chip
+        # engine (engine/chunk.py); here the insert routes fingerprints
+        # to their owner chips, and P is pmin-replicated via the
+        # compactor's reduce_p hook so all chips advance in lockstep.
+        chunk_body = build_chunk_body(
+            dims=dims, expand=expand, fingerprint=fingerprint,
+            pack_ok=pack_ok, inv_fns=inv_fns, constraint=constraint,
+            B=B, G=G, K=K, Q=QL, TQ=TQ, record_static=record_static,
+            compactor=compactor, insert_fn=route_insert)
 
         def sharded_chunk(qcur, cur_counts, offset0, qnext, next_counts,
                           shi, slo, ssize, tbuf, tcount0, max_steps,
