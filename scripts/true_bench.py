@@ -12,6 +12,10 @@ import time
 
 sys.path.insert(0, ".")
 
+from raft_tla_tpu.utils.platform import neutralize_axon_if_cpu_requested
+
+neutralize_axon_if_cpu_requested()   # honor JAX_PLATFORMS=cpu
+
 import jax
 import jax.numpy as jnp
 import numpy as np

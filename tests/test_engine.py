@@ -180,6 +180,25 @@ def test_duration_budget_promptness():
         (res.wall_seconds, budget, eng._batch_ema)
 
 
+def test_progress_limiting_with_tiny_compact_buffer():
+    """Results are invariant under the compacted-lane budget (ops/
+    compact.py): a K too small for a whole batch's fan-out must advance
+    fewer parents per step, never drop states.  K floors at max(G, B), so
+    a large batch with the minimum K forces P < B on every busy step."""
+    base = BFSEngine(DIMS, constraint=build_constraint(DIMS, BOUNDS),
+                     config=small_config(max_diameter=3))
+    want = base.run([init_state(DIMS)])
+    eng = BFSEngine(DIMS, constraint=build_constraint(DIMS, BOUNDS),
+                    config=small_config(batch=64, compact_lanes=1,
+                                        max_diameter=3))
+    assert eng._K == 256          # floor: _pow2(max(1, G=132, B=64))
+    got = eng.run([init_state(DIMS)])
+    assert got.distinct == want.distinct
+    assert got.levels == want.levels
+    assert got.generated == want.generated
+    assert got.diameter == want.diameter
+
+
 def test_order_independence_of_exploration():
     """Metamorphic (SURVEY §5.2, the race-detector analog): the distinct
     count, per-level sizes, and diameter are invariant under (a) frontier

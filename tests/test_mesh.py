@@ -137,6 +137,25 @@ def test_mesh_checkpoint_resumes_on_mesh_and_single(tmp_path):
     assert got_single.levels == want.levels
 
 
+def test_mesh_progress_limiting_with_tiny_compact_buffer():
+    """P-limiting under the pmin-replicated offset advance (ops/
+    compact.py reduce_p): a compact buffer too small for a batch's
+    fan-out must not change any count on the mesh — every chip advances
+    by the same replicated P, so lockstep trip counts hold even when
+    chips see different fan-outs."""
+    cons = build_constraint(DIMS, BOUNDS)
+    want = MeshBFSEngine(DIMS, constraint=cons,
+                         config=small_mesh_config(max_diameter=3)).run(
+        [init_state(DIMS)])
+    got = MeshBFSEngine(DIMS, constraint=cons,
+                        config=small_mesh_config(
+                            batch=32, compact_lanes=1,
+                            max_diameter=3)).run([init_state(DIMS)])
+    assert got.distinct == want.distinct
+    assert got.levels == want.levels
+    assert got.generated == want.generated
+
+
 def test_mesh_order_independence():
     """Root permutation and batch-boundary changes must not change mesh
     counts (guards the owner-routed all_to_all dedup)."""
