@@ -1,0 +1,25 @@
+------------------------- MODULE TPUraftDelegate -------------------------
+(* Stock-TLC front door for the TPU checker (SURVEY §2.4 R10).
+
+   TPUCheck is a no-op at the TLA+ level; the module override in
+   TPUraftOverride.java (same directory) replaces it at load time with a
+   socket call to `python -m raft_tla_tpu.server`.  Checking this module
+   with plain TLC therefore runs the full TPU-engine check of the .cfg
+   named below and fails iff the TPU checker finds a violation.        *)
+EXTENDS Naturals, TLC
+
+CONSTANTS CfgPath, Host, Port
+
+TPUCheck(path, host, port) == [ok |-> FALSE, distinct |-> 0,
+                               generated |-> 0, diameter |-> 0]
+
+VARIABLE done
+
+Init == done = FALSE
+Next == /\ done = FALSE
+        /\ done' = TRUE
+        /\ LET r == TPUCheck(CfgPath, Host, Port)
+           IN Assert(r.ok, <<"TPU check failed", r>>)
+
+Delegated == [][Next]_done
+=============================================================================

@@ -1,0 +1,111 @@
+/*
+ * TLC module override delegating to the raft_tla_tpu checker service
+ * (SURVEY §2.4 R10: "the mechanism by which a stock TLC CLI can delegate
+ * to the TPU engine").
+ *
+ * TLA+ side (TPUraftDelegate.tla in this directory) declares:
+ *
+ *     TPUCheck(cfgPath, host, port) == FALSE  \* overridden by this class
+ *
+ * and this class replaces that operator at TLC load time via the
+ * tlc2.overrides.TLAPlusOperator mechanism: it opens a TCP connection to
+ * the checker service (python -m raft_tla_tpu.server), sends one
+ * newline-delimited JSON "check" request for the given .cfg, and returns
+ * the response's headline statistics as a TLA+ record
+ * [distinct |-> n, generated |-> n, diameter |-> n, ok |-> bool].
+ * A violation reported by the service fails the operator (TLC reports the
+ * error with the service's counterexample text in the message).
+ *
+ * Build (needs tla2tools.jar, not present in this image — this file is
+ * shipped as source, compiled by the user; the socket protocol itself is
+ * unit-tested in tests/test_server.py):
+ *
+ *     javac -cp tla2tools.jar TPUraftOverride.java
+ *     jar cf tpuraft-override.jar tlc2/
+ *     java -cp tla2tools.jar:tpuraft-override.jar tlc2.TLC \
+ *          -config TPUraftDelegate.cfg TPUraftDelegate
+ */
+package tlc2.overrides;
+
+import java.io.BufferedReader;
+import java.io.InputStreamReader;
+import java.io.OutputStreamWriter;
+import java.io.Writer;
+import java.net.Socket;
+import java.nio.charset.StandardCharsets;
+
+import tlc2.value.impl.BoolValue;
+import tlc2.value.impl.IntValue;
+import tlc2.value.impl.RecordValue;
+import tlc2.value.impl.StringValue;
+import tlc2.value.impl.Value;
+import util.UniqueString;
+
+public class TPUraftOverride {
+
+    @TLAPlusOperator(identifier = "TPUCheck", module = "TPUraftDelegate",
+                     warn = false)
+    public static Value tpuCheck(final StringValue cfgPath,
+                                 final StringValue host,
+                                 final IntValue port) throws Exception {
+        final String req = "{\"op\": \"check\", \"cfg\": \""
+                + cfgPath.val.toString().replace("\\", "\\\\")
+                             .replace("\"", "\\\"")
+                + "\"}\n";
+        try (Socket s = new Socket(host.val.toString(), port.val)) {
+            final Writer w = new OutputStreamWriter(
+                    s.getOutputStream(), StandardCharsets.UTF_8);
+            w.write(req);
+            w.flush();
+            final BufferedReader r = new BufferedReader(
+                    new InputStreamReader(s.getInputStream(),
+                                          StandardCharsets.UTF_8));
+            final String line = r.readLine();
+            if (line == null) {
+                throw new RuntimeException("checker service closed");
+            }
+            // Minimal JSON field extraction (flat integer fields only) —
+            // avoids a JSON dependency inside the TLC classpath.
+            final boolean ok = line.contains("\"ok\": true");
+            final boolean violated = !line.contains("\"violation\": null");
+            final boolean deadlocked = !line.contains("\"deadlock\": null");
+            if (ok && (violated || deadlocked)) {
+                throw new RuntimeException(
+                        "TPU checker reported a "
+                        + (violated ? "violation" : "deadlock")
+                        + ": " + line);
+            }
+            final UniqueString[] names = new UniqueString[] {
+                UniqueString.uniqueStringOf("ok"),
+                UniqueString.uniqueStringOf("distinct"),
+                UniqueString.uniqueStringOf("generated"),
+                UniqueString.uniqueStringOf("diameter"),
+            };
+            final Value[] values = new Value[] {
+                ok ? BoolValue.ValTrue : BoolValue.ValFalse,
+                IntValue.gen(extractInt(line, "distinct")),
+                IntValue.gen(extractInt(line, "generated")),
+                IntValue.gen(extractInt(line, "diameter")),
+            };
+            return new RecordValue(names, values, false);
+        }
+    }
+
+    private static int extractInt(final String json, final String key) {
+        final String needle = "\"" + key + "\": ";
+        final int at = json.indexOf(needle);
+        if (at < 0) {
+            return -1;
+        }
+        int end = at + needle.length();
+        int v = 0;
+        boolean any = false;
+        while (end < json.length()
+                && Character.isDigit(json.charAt(end))) {
+            v = v * 10 + (json.charAt(end) - '0');
+            end++;
+            any = true;
+        }
+        return any ? v : -1;
+    }
+}

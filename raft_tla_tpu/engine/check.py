@@ -76,7 +76,12 @@ def engine_config_from_backend(setup: CheckSetup) -> EngineConfig:
 
 
 def make_engine(setup: CheckSetup,
-                engine_config: Optional[EngineConfig] = None) -> BFSEngine:
+                engine_config: Optional[EngineConfig] = None,
+                engine_cls=None):
+    """Build a checker engine with the cfg-file fallbacks applied
+    (CHECK_DEADLOCK, StopAfter budgets).  ``engine_cls`` selects the
+    implementation — BFSEngine (default) or parallel.mesh.MeshBFSEngine —
+    so every entry point resolves the config identically."""
     import dataclasses as _dc
     base = engine_config or engine_config_from_backend(setup)
     cfg = _dc.replace(          # never mutate the caller's config
@@ -88,8 +93,9 @@ def make_engine(setup: CheckSetup,
                      else setup.max_seconds),
         max_diameter=(base.max_diameter if base.max_diameter is not None
                       else setup.max_diameter))
-    return BFSEngine(setup.dims, invariants=resolve_invariants(setup),
-                     constraint=resolve_constraint(setup), config=cfg)
+    cls = engine_cls or BFSEngine
+    return cls(setup.dims, invariants=resolve_invariants(setup),
+               constraint=resolve_constraint(setup), config=cfg)
 
 
 def initial_states(setup: CheckSetup, seed: int = 0) -> List[PyState]:

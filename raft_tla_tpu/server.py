@@ -1,0 +1,245 @@
+"""Checker service — the TLC-delegation endpoint (SURVEY §2.4 R10).
+
+TLC's distributed mode lets a stock CLI hand work to external processes;
+the analogous integration here is a long-lived service wrapping the TPU
+engines, reachable from anything that can open a socket — in particular
+the TLC module override shipped in ``native/tlc_override/`` (a Java
+operator that forwards a ``.cfg`` to this service and returns the result
+as a TLA+ record), but also ad-hoc drivers and notebooks.  The service
+holds the compiled engines warm between requests, so repeat checks of the
+same model skip XLA compilation.
+
+Protocol: newline-delimited JSON over TCP; one request per line, one
+response per line.  Requests:
+
+    {"op": "ping"}
+        -> {"ok": true, "platform": "tpu"}
+    {"op": "check", "cfg": "<path>" | "cfg_text": "<.cfg contents>",
+     "batch": 1024, "max_seconds": 60.0, "max_diameter": null,
+     "queue_capacity": null, "seen_capacity": null, "trace": false,
+     "engine": "single" | "mesh"}
+        -> {"ok": true, "distinct": N, "generated": N, "diameter": N,
+            "levels": [...], "stop_reason": "...",
+            "violation": null | {"invariant": "...", "fingerprint": "0x..",
+                                 "trace": [{"action": "...",
+                                            "state": "..."}, ...]},
+            "deadlock": null | "<state>", "wall_seconds": S}
+    {"op": "simulate", "cfg": ..., "num_steps": N, "depth": D,
+     "batch": B, "seed": 0, "max_seconds": S}
+        -> {"ok": true, "steps": N, "traces": N, "wall_seconds": S,
+            "violation": null | {...}}
+
+Errors: {"ok": false, "error": "<message>"}.  Requests are served one at
+a time (a checking run owns the device); concurrent connections queue.
+
+Run:  python -m raft_tla_tpu.server [--port 8610] [--platform cpu]
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import socketserver
+import tempfile
+import threading
+from typing import Optional
+
+_LOCK = threading.Lock()          # one engine run at a time (one device)
+_ENGINES: dict = {}               # (cfg identity, options) -> warm engine
+_SIMS: dict = {}                  # ditto for simulators
+
+
+def _load_setup(req):
+    """Returns (setup, identity).  Identity is a hash of the cfg CONTENT
+    (not the path): editing a .cfg between requests must never serve the
+    previous model's engine."""
+    import hashlib
+    from .utils.cfg import load_config
+    if req.get("cfg"):
+        path = req["cfg"]
+        with open(path, "rb") as f:
+            ident = hashlib.sha256(f.read()).hexdigest()
+        return load_config(path), ident
+    if req.get("cfg_text"):
+        text = req["cfg_text"]
+        ident = hashlib.sha256(text.encode()).hexdigest()
+        f = tempfile.NamedTemporaryFile("w", suffix=".cfg", delete=False)
+        try:
+            f.write(text)
+            f.close()
+            return load_config(f.name), ident
+        finally:
+            os.unlink(f.name)
+    raise ValueError("need 'cfg' (path) or 'cfg_text'")
+
+
+def _violation_json(engine, violation, dims):
+    from .models.pystate import format_state
+    out = {"invariant": violation.invariant,
+           "fingerprint": hex(violation.fingerprint)}
+    try:
+        steps = engine.replay(violation.fingerprint)
+        out["trace"] = [
+            {"action": ("Init" if g < 0 else dims.describe_instance(g)),
+             "state": format_state(st, dims)}
+            for g, st in steps]
+    except Exception as e:          # trace-off runs: report the state only
+        out["trace"] = []
+        out["trace_error"] = str(e)
+        out["state"] = format_state(violation.state, dims)
+    return out
+
+
+def _do_check(req):
+    from .engine.bfs import EngineConfig
+    from .engine.check import initial_states, make_engine
+
+    from .models.pystate import format_state
+
+    setup, ident = _load_setup(req)
+    record_trace = bool(req.get("trace", False))
+    cfg = EngineConfig(
+        batch=int(req.get("batch", 1024)),
+        queue_capacity=req.get("queue_capacity"),
+        seen_capacity=req.get("seen_capacity"),
+        max_seconds=req.get("max_seconds"),
+        max_diameter=req.get("max_diameter"),
+        record_trace=record_trace,
+        check_deadlock=req.get("check_deadlock"))
+    # check_deadlock is baked into the compiled program, so it keys the
+    # cache; the StopAfter budgets are host-side and are refreshed on the
+    # cached engine's config below.
+    key = (ident, req.get("engine", "single"), cfg.batch,
+           cfg.queue_capacity, cfg.seen_capacity, record_trace,
+           cfg.check_deadlock)
+    engine = _ENGINES.get(key)
+    if engine is None:
+        engine_cls = None
+        if req.get("engine") == "mesh":
+            from .parallel.mesh import MeshBFSEngine
+            engine_cls = MeshBFSEngine
+        # make_engine applies the cfg-file fallbacks (CHECK_DEADLOCK,
+        # StopAfter) identically for both engine classes.
+        engine = make_engine(setup, cfg, engine_cls=engine_cls)
+        _ENGINES[key] = engine
+    # Budgets are per-request: apply the request value (or the cfg-file
+    # fallback) to the warm engine's host-side config.
+    engine.config.max_seconds = (cfg.max_seconds
+                                 if cfg.max_seconds is not None
+                                 else setup.max_seconds)
+    engine.config.max_diameter = (cfg.max_diameter
+                                  if cfg.max_diameter is not None
+                                  else setup.max_diameter)
+    res = engine.run(initial_states(setup, seed=int(req.get("seed", 0))))
+    out = {"ok": True, "distinct": res.distinct,
+           "generated": res.generated, "diameter": res.diameter,
+           "levels": list(res.levels), "stop_reason": res.stop_reason,
+           "wall_seconds": round(res.wall_seconds, 3),
+           "violation": None, "deadlock": None}
+    if res.violation is not None:
+        out["violation"] = _violation_json(engine, res.violation,
+                                           setup.dims)
+    if res.deadlock is not None:
+        out["deadlock"] = format_state(res.deadlock, setup.dims)
+    return out
+
+
+def _do_simulate(req):
+    from .engine.check import resolve_constraint, resolve_invariants
+    from .engine.simulate import Simulator
+    from .engine.check import initial_states
+
+    setup, ident = _load_setup(req)
+    batch = int(req.get("batch", 1024))
+    depth = int(req.get("depth", 100))
+    key = (ident, batch, depth)
+    sim = _SIMS.get(key)           # warm path, like _ENGINES for checks
+    if sim is None:
+        sim = Simulator(setup.dims,
+                        invariants=resolve_invariants(setup),
+                        constraint=resolve_constraint(setup),
+                        batch=batch, depth=depth)
+        _SIMS[key] = sim
+    res = sim.run(initial_states(setup, seed=int(req.get("seed", 0))),
+                  num_steps=int(req.get("num_steps", 1 << 20)),
+                  seed=int(req.get("seed", 0)),
+                  max_seconds=req.get("max_seconds"))
+    out = {"ok": True, "steps": res.steps, "traces": res.traces,
+           "wall_seconds": round(res.wall_seconds, 3), "violation": None}
+    if res.violation_invariant is not None:
+        from .models.pystate import format_state
+        out["violation"] = {
+            "invariant": res.violation_invariant,
+            "trace": [
+                {"action": ("Init" if g < 0
+                            else setup.dims.describe_instance(g)),
+                 "state": format_state(st, setup.dims)}
+                for g, st in (res.violation_trace or [])]}
+    return out
+
+
+def handle_request(req: dict) -> dict:
+    op = req.get("op")
+    try:
+        if op == "ping":
+            import jax
+            return {"ok": True, "platform": jax.devices()[0].platform}
+        with _LOCK:
+            if op == "check":
+                return _do_check(req)
+            if op == "simulate":
+                return _do_simulate(req)
+        return {"ok": False, "error": f"unknown op {op!r}"}
+    except Exception as e:
+        return {"ok": False, "error": f"{type(e).__name__}: {e}"}
+
+
+class _Handler(socketserver.StreamRequestHandler):
+    def handle(self):
+        for line in self.rfile:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                req = json.loads(line)
+            except json.JSONDecodeError as e:
+                resp = {"ok": False, "error": f"bad json: {e}"}
+            else:
+                resp = handle_request(req)
+            self.wfile.write((json.dumps(resp) + "\n").encode())
+            self.wfile.flush()
+
+
+class CheckerServer(socketserver.ThreadingTCPServer):
+    allow_reuse_address = True
+    daemon_threads = True
+
+
+def serve(host: str = "127.0.0.1", port: int = 8610) -> CheckerServer:
+    """Create (and return) a listening server; caller decides threading.
+    Port 0 picks an ephemeral port (see ``server_address[1]``)."""
+    return CheckerServer((host, port), _Handler)
+
+
+def main(argv=None):
+    import argparse
+    p = argparse.ArgumentParser(prog="raft_tla_tpu.server")
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--port", type=int, default=8610)
+    p.add_argument("--platform", default=None,
+                   help="jax platform override (e.g. cpu)")
+    args = p.parse_args(argv)
+    if args.platform == "cpu":
+        from .utils.platform import force_cpu
+        force_cpu()
+    srv = serve(args.host, args.port)
+    print(f"raft_tla_tpu checker service on "
+          f"{srv.server_address[0]}:{srv.server_address[1]}")
+    try:
+        srv.serve_forever()
+    except KeyboardInterrupt:
+        pass
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,117 @@
+"""Checker-service protocol tests (SURVEY §2.4 R10 delegation endpoint).
+
+A live server on an ephemeral port, a socket client speaking the same
+newline-delimited JSON the TLC override (native/tlc_override/
+TPUraftOverride.java) sends.  Counts are asserted against the pinned
+MCraft_bounded oracle profile, so the service is checked end-to-end
+through the real engine, not a stub.
+"""
+
+import json
+import os
+import socket
+import threading
+
+import pytest
+
+from raft_tla_tpu import server as srv_mod
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(scope="module")
+def server():
+    srv = srv_mod.serve(port=0)
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    yield srv.server_address
+    srv.shutdown()
+
+
+def roundtrip(addr, req: dict) -> dict:
+    with socket.create_connection(addr, timeout=600) as s:
+        s.sendall((json.dumps(req) + "\n").encode())
+        buf = b""
+        while not buf.endswith(b"\n"):
+            chunk = s.recv(65536)
+            if not chunk:
+                break
+            buf += chunk
+    return json.loads(buf)
+
+
+def test_ping(server):
+    resp = roundtrip(server, {"op": "ping"})
+    assert resp["ok"] is True
+    assert resp["platform"] == "cpu"
+
+
+def test_check_matches_pinned_profile(server):
+    resp = roundtrip(server, {
+        "op": "check",
+        "cfg": os.path.join(REPO, "configs/MCraft_bounded.cfg"),
+        "batch": 128, "max_diameter": 3,
+        "queue_capacity": 1 << 12, "seen_capacity": 1 << 15,
+        "check_deadlock": False})
+    assert resp["ok"] is True, resp
+    # Pinned oracle prefix (BASELINE.md §b): cumulative 113 distinct /
+    # 222 generated through level 3.
+    assert resp["distinct"] == 113
+    assert resp["generated"] == 222
+    assert resp["diameter"] == 3
+    assert resp["levels"] == [1, 3, 18, 79]
+    assert resp["violation"] is None
+
+
+def test_check_engine_stays_warm_and_budgets_refresh(server):
+    # Second request with a DIFFERENT diameter budget must reuse the
+    # compiled engine but honor the new budget — budgets are host-side
+    # and per-request, not baked into the cache entry.
+    base = {"op": "check",
+            "cfg": os.path.join(REPO, "configs/MCraft_bounded.cfg"),
+            "batch": 128,
+            "queue_capacity": 1 << 12, "seen_capacity": 1 << 15,
+            "check_deadlock": False}
+    r1 = roundtrip(server, dict(base, max_diameter=3))
+    assert r1["ok"] and r1["distinct"] == 113
+    r2 = roundtrip(server, dict(base, max_diameter=4))
+    assert r2["ok"] and r2["distinct"] == 527     # pinned L4 cumulative
+    assert r2["levels"] == [1, 3, 18, 79, 318]
+
+
+def test_cfg_text_and_content_identity(server):
+    # cfg_text requests work, and the engine cache keys on CONTENT: two
+    # different texts (different MaxTerm) must give different models.
+    with open(os.path.join(REPO, "configs/MCraft_bounded.cfg")) as f:
+        text = f.read()
+    r1 = roundtrip(server, {
+        "op": "check", "cfg_text": text, "batch": 128, "max_diameter": 4,
+        "queue_capacity": 1 << 12, "seen_capacity": 1 << 15,
+        "check_deadlock": False})
+    assert r1["ok"] and r1["distinct"] == 527     # pinned L4 cumulative
+    text2 = text.replace("MaxTerm = 3", "MaxTerm = 2")
+    assert text2 != text
+    r2 = roundtrip(server, {
+        "op": "check", "cfg_text": text2, "batch": 128, "max_diameter": 4,
+        "queue_capacity": 1 << 12, "seen_capacity": 1 << 15,
+        "check_deadlock": False})
+    assert r2["ok"]
+    assert r2["distinct"] < r1["distinct"]        # tighter term bound
+
+
+def test_simulate(server):
+    resp = roundtrip(server, {
+        "op": "simulate",
+        "cfg": os.path.join(REPO, "configs/MCraft_bounded.cfg"),
+        "batch": 64, "depth": 16, "num_steps": 256})
+    assert resp["ok"] is True, resp
+    assert resp["steps"] >= 256
+    assert resp["traces"] >= 64
+    assert resp["violation"] is None
+
+
+def test_bad_request(server):
+    resp = roundtrip(server, {"op": "nope"})
+    assert resp["ok"] is False
+    resp = roundtrip(server, {"op": "check"})
+    assert resp["ok"] is False and "cfg" in resp["error"]
