@@ -67,6 +67,12 @@ public class TPUraftOverride {
             // Minimal JSON field extraction (flat integer fields only) —
             // avoids a JSON dependency inside the TLC classpath.
             final boolean ok = line.contains("\"ok\": true");
+            if (!ok) {
+                // Surface the service's own error text (bad cfg path,
+                // parse failure, ...) instead of a -1-stats record.
+                throw new RuntimeException(
+                        "TPU checker service error: " + line);
+            }
             final boolean violated = !line.contains("\"violation\": null");
             final boolean deadlocked = !line.contains("\"deadlock\": null");
             if (ok && (violated || deadlocked)) {

@@ -152,12 +152,12 @@ def _auto_capacities(sw: int, batch: int,
     """(queue rows, seen keys) sized from the device's reported HBM.
 
     Budget (after a 25% headroom for XLA temporaries and the candidate
-    buffers): half to the two level queues (+ trace buffer when tracing),
-    a quarter to the fingerprint table (8 B/slot).  TLC has no equivalent
-    — its queue and FPSet page to disk; here the spill path plays that
-    role and these sizes only set the device-resident working set.
-    Falls back to modest defaults when the backend reports no limit
-    (virtual CPU devices)."""
+    buffers): half to the three level queues (current, next, and the
+    async-spill spare; + trace buffer when tracing), a quarter to the
+    fingerprint table (8 B/slot).  TLC has no equivalent — its queue and
+    FPSet page to disk; here the spill path plays that role and these
+    sizes only set the device-resident working set.  Falls back to modest
+    defaults when the backend reports no limit (virtual CPU devices)."""
     limit = None
     try:
         stats = jax.devices()[0].memory_stats()
@@ -179,7 +179,7 @@ def _auto_capacities(sw: int, batch: int,
         else:
             return 1 << 20, 1 << 22
     usable = int(limit * 0.75)
-    row_cost = 2 * sw + (20 if record_trace else 0)   # queues + trace row
+    row_cost = 3 * sw + (20 if record_trace else 0)   # queues + trace row
     q = max(batch, min(usable // 2 // row_cost, 1 << 25))
     s = max(1 << 18, min(usable // 4 // 8, 1 << 28))
     return q, s
@@ -464,6 +464,19 @@ class BFSEngine:
         # state queue, in host RAM.
         pending: List[np.ndarray] = []
         spill_next: List[np.ndarray] = []
+        # Async spill: a watermark drain kicks off a non-blocking D2H of
+        # the full next-queue and swaps in a spare buffer, so the drain
+        # overlaps the following chunks' compute; the transfer is resolved
+        # (and the buffer recycled) at the next drain or level boundary.
+        free_q: List = [jnp.zeros((QA, sw), jnp.uint8)]
+        inflight: List = []        # [(device array, row count)]
+
+        def resolve_spill():
+            while inflight:
+                arr, cnt = inflight.pop(0)
+                host = np.asarray(arr)      # completes the async copy
+                spill_next.append(host[:cnt])
+                free_q.append(arr)
         TA = self._TA
         tbuf = (jnp.zeros((TA,), jnp.uint32), jnp.zeros((TA,), jnp.uint32),
                 jnp.zeros((TA,), jnp.uint32), jnp.zeros((TA,), jnp.uint32),
@@ -531,6 +544,14 @@ class BFSEngine:
             # Ingest initial states in B-sized chunks (roots registered
             # above, before the clock).
             for base in range(0, len(rows_np), B):
+                # StopAfter applies during root ingest too (a k=4 smoke
+                # run has 262k roots — TLCGet("duration") doesn't wait
+                # for them).  The first wave always runs: TLC generates
+                # initial states before any constraint can stop it.
+                if base and cfg.max_seconds is not None \
+                        and time.time() - t0 > cfg.max_seconds:
+                    res.stop_reason = "duration_budget"
+                    break
                 chunk = rows_np[base:base + B]
                 pad = np.zeros((B - len(chunk), sw), ROW_DTYPE)
                 valid = np.arange(B) < len(chunk)
@@ -606,6 +627,11 @@ class BFSEngine:
                         if self._batch_ema:
                             allowed = max(1, min(
                                 self._CH, int(remaining / self._batch_ema)))
+                        else:
+                            # No cost estimate yet: probe with one batch
+                            # so the first call can't blow the deadline
+                            # by a whole sync_every chunk.
+                            allowed = 1
                     t_call = time.time()
                     out = self._chunk(qcur, jnp.int32(cur_count),
                                       jnp.int32(offset), qnext,
@@ -651,9 +677,13 @@ class BFSEngine:
                             and (offset < cur_count or pending):
                         # Next-level queue at the watermark with more of
                         # this level still to expand: drain it to host
-                        # (TLC's disk queue) and keep going.
-                        spill_next.append(
-                            np.asarray(qnext[:next_count_h]).copy())
+                        # (TLC's disk queue) asynchronously — swap in the
+                        # spare buffer and let the D2H ride behind the
+                        # next chunks' compute.
+                        resolve_spill()
+                        qnext.copy_to_host_async()
+                        inflight.append((qnext, next_count_h))
+                        qnext = free_q.pop()
                         next_count_h = 0
                     if viol_any:
                         vrow, vhl = np.asarray(out[5]), np.asarray(out[6])
@@ -680,6 +710,7 @@ class BFSEngine:
                 cur_count = len(seg)
             if res.stop_reason != "exhausted" or res.violation is not None:
                 break  # aborted mid-level: diameter counts completed levels
+            resolve_spill()      # level boundary: all drains must land
             res.diameter += 1
             res.levels.append(next_count_h
                               + sum(len(s) for s in spill_next))

@@ -391,6 +391,16 @@ class MeshBFSEngine:
         tcount = jnp.zeros((n,), _I32)
         pending: List[np.ndarray] = []   # host pool (rows), global
         spill_next: List[np.ndarray] = []
+        # Async spill (engine/bfs.py): drains ride behind compute via a
+        # spare next-queue; resolved at the next drain or level boundary.
+        free_q: List = [jnp.zeros((n, QLA, sw), jnp.uint8)]
+        inflight: List = []              # [(device array, per-chip counts)]
+
+        def resolve_spill():
+            while inflight:
+                arr, cnts = inflight.pop(0)
+                spill_next.append(self._drain(np.asarray(arr), cnts))
+                free_q.append(arr)
 
         if resume is None:
             encoded = [encode_state(s, dims) for s in init_states]
@@ -468,6 +478,12 @@ class MeshBFSEngine:
             per_chip = [rows_np[i::n] for i in range(n)]
             max_chunks = max((-(-len(p) // B) for p in per_chip), default=0)
             for c in range(max_chunks):
+                # StopAfter covers ingest; the first wave always runs
+                # (engine/bfs.py rationale).
+                if c and cfg.max_seconds is not None \
+                        and time.time() - t0 > cfg.max_seconds:
+                    res.stop_reason = "duration_budget"
+                    break
                 wave = np.zeros((n, B, sw), ROW_DTYPE)
                 valid = np.zeros((n, B), bool)
                 for d in range(n):
@@ -534,6 +550,9 @@ class MeshBFSEngine:
                             allowed = max(1, min(
                                 self._CH,
                                 int(remaining / self._batch_ema)))
+                        else:
+                            allowed = 1    # no estimate yet: probe batch
+                                           # (engine/bfs.py rationale)
                     t_call = time.time()
                     out = self._chunk(
                         qcur, jnp.asarray(cur_counts, _I32),
@@ -574,7 +593,10 @@ class MeshBFSEngine:
                     ncnt = lc[:, 0]
                     if int(ncnt.max()) > self._QTH \
                             and (offset < max_count or pending):
-                        spill_next.append(self._drain(qnext, ncnt))
+                        resolve_spill()
+                        qnext.copy_to_host_async()
+                        inflight.append((qnext, ncnt.copy()))
+                        qnext = free_q.pop()
                         next_counts = jnp.zeros((n,), _I32)
                     viol_chips = lc[:, 4]
                     if viol_chips.any():
@@ -611,6 +633,7 @@ class MeshBFSEngine:
                 qcur = jax.device_put(buf, NamedSharding(self.mesh, P("x")))
             if res.stop_reason != "exhausted" or res.violation is not None:
                 break
+            resolve_spill()      # level boundary: all drains must land
             res.diameter += 1
             nc = np.asarray(next_counts)
             res.levels.append(int(nc.sum())

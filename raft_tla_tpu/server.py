@@ -45,8 +45,27 @@ import threading
 from typing import Optional
 
 _LOCK = threading.Lock()          # one engine run at a time (one device)
-_ENGINES: dict = {}               # (cfg identity, options) -> warm engine
-_SIMS: dict = {}                  # ditto for simulators
+# Warm caches, LRU-capped: a long-lived service iterating on cfg_text
+# variants must not pin one compiled engine (plus its trace store) per
+# variant forever.
+_CACHE_CAP = 8
+from collections import OrderedDict  # noqa: E402
+_ENGINES: "OrderedDict" = OrderedDict()   # (cfg identity, opts) -> engine
+_SIMS: "OrderedDict" = OrderedDict()      # ditto for simulators
+
+
+def _cache_put(cache: "OrderedDict", key, value):
+    cache[key] = value
+    cache.move_to_end(key)
+    while len(cache) > _CACHE_CAP:
+        cache.popitem(last=False)
+
+
+def _cache_get(cache: "OrderedDict", key):
+    v = cache.get(key)
+    if v is not None:
+        cache.move_to_end(key)
+    return v
 
 
 def _load_setup(req):
@@ -96,12 +115,22 @@ def _do_check(req):
 
     from .models.pystate import format_state
 
+    import dataclasses
+    from .engine.check import engine_config_from_backend
+
     setup, ident = _load_setup(req)
     record_trace = bool(req.get("trace", False))
-    cfg = EngineConfig(
-        batch=int(req.get("batch", 1024)),
-        queue_capacity=req.get("queue_capacity"),
-        seen_capacity=req.get("seen_capacity"),
+    # Precedence everywhere (utils/cfg.py): request field > cfg "\* TPU:"
+    # backend directive > built-in default — the backend-seeded config is
+    # the base, request fields overlay only when present.
+    base = engine_config_from_backend(setup)
+    cfg = dataclasses.replace(
+        base,
+        batch=int(req["batch"]) if "batch" in req else base.batch,
+        queue_capacity=(req["queue_capacity"] if "queue_capacity" in req
+                        else base.queue_capacity),
+        seen_capacity=(req["seen_capacity"] if "seen_capacity" in req
+                       else base.seen_capacity),
         max_seconds=req.get("max_seconds"),
         max_diameter=req.get("max_diameter"),
         record_trace=record_trace,
@@ -112,7 +141,7 @@ def _do_check(req):
     key = (ident, req.get("engine", "single"), cfg.batch,
            cfg.queue_capacity, cfg.seen_capacity, record_trace,
            cfg.check_deadlock)
-    engine = _ENGINES.get(key)
+    engine = _cache_get(_ENGINES, key)
     if engine is None:
         engine_cls = None
         if req.get("engine") == "mesh":
@@ -121,7 +150,7 @@ def _do_check(req):
         # make_engine applies the cfg-file fallbacks (CHECK_DEADLOCK,
         # StopAfter) identically for both engine classes.
         engine = make_engine(setup, cfg, engine_cls=engine_cls)
-        _ENGINES[key] = engine
+        _cache_put(_ENGINES, key, engine)
     # Budgets are per-request: apply the request value (or the cfg-file
     # fallback) to the warm engine's host-side config.
     engine.config.max_seconds = (cfg.max_seconds
@@ -135,6 +164,7 @@ def _do_check(req):
            "generated": res.generated, "diameter": res.diameter,
            "levels": list(res.levels), "stop_reason": res.stop_reason,
            "wall_seconds": round(res.wall_seconds, 3),
+           "batch": engine.config.batch,      # resolved, for observability
            "violation": None, "deadlock": None}
     if res.violation is not None:
         out["violation"] = _violation_json(engine, res.violation,
@@ -150,16 +180,17 @@ def _do_simulate(req):
     from .engine.check import initial_states
 
     setup, ident = _load_setup(req)
-    batch = int(req.get("batch", 1024))
+    batch = (int(req["batch"]) if "batch" in req
+             else int(setup.backend.get("BATCH", 1024)))
     depth = int(req.get("depth", 100))
     key = (ident, batch, depth)
-    sim = _SIMS.get(key)           # warm path, like _ENGINES for checks
+    sim = _cache_get(_SIMS, key)   # warm path, like _ENGINES for checks
     if sim is None:
         sim = Simulator(setup.dims,
                         invariants=resolve_invariants(setup),
                         constraint=resolve_constraint(setup),
                         batch=batch, depth=depth)
-        _SIMS[key] = sim
+        _cache_put(_SIMS, key, sim)
     res = sim.run(initial_states(setup, seed=int(req.get("seed", 0))),
                   num_steps=int(req.get("num_steps", 1 << 20)),
                   seed=int(req.get("seed", 0)),

@@ -99,6 +99,24 @@ def test_cfg_text_and_content_identity(server):
     assert r2["distinct"] < r1["distinct"]        # tighter term bound
 
 
+def test_backend_directive_precedence(server):
+    # Precedence: request field > cfg "\* TPU:" directive > default.  A
+    # cfg_text carrying a BATCH directive must drive the engine batch
+    # when the request leaves it unset.
+    with open(os.path.join(REPO, "configs/MCraft_bounded.cfg")) as f:
+        text = f.read() + "\n\\* TPU: BATCH = 64\n"
+    r = roundtrip(server, {
+        "op": "check", "cfg_text": text, "max_diameter": 2,
+        "queue_capacity": 1 << 12, "seen_capacity": 1 << 15,
+        "check_deadlock": False})
+    assert r["ok"] and r["batch"] == 64 and r["distinct"] == 22
+    r2 = roundtrip(server, {
+        "op": "check", "cfg_text": text, "batch": 32, "max_diameter": 2,
+        "queue_capacity": 1 << 12, "seen_capacity": 1 << 15,
+        "check_deadlock": False})
+    assert r2["ok"] and r2["batch"] == 32 and r2["distinct"] == 22
+
+
 def test_simulate(server):
     resp = roundtrip(server, {
         "op": "simulate",
