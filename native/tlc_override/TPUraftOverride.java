@@ -75,7 +75,7 @@ public class TPUraftOverride {
             }
             final boolean violated = !line.contains("\"violation\": null");
             final boolean deadlocked = !line.contains("\"deadlock\": null");
-            if (ok && (violated || deadlocked)) {
+            if (violated || deadlocked) {
                 throw new RuntimeException(
                         "TPU checker reported a "
                         + (violated ? "violation" : "deadlock")

@@ -475,7 +475,10 @@ class BFSEngine:
             while inflight:
                 arr, cnt = inflight.pop(0)
                 host = np.asarray(arr)      # completes the async copy
-                spill_next.append(host[:cnt])
+                # .copy(): on CPU backends np.asarray can be a zero-copy
+                # VIEW of the device buffer, which is about to be recycled
+                # and donated — and a view would also pin all QA rows.
+                spill_next.append(host[:cnt].copy())
                 free_q.append(arr)
         TA = self._TA
         tbuf = (jnp.zeros((TA,), jnp.uint32), jnp.zeros((TA,), jnp.uint32),

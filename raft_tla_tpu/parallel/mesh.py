@@ -315,12 +315,6 @@ class MeshBFSEngine:
                             if inv_fns else None)
 
     # ------------------------------------------------------------------
-    def _empty_tbuf(self):
-        n, TA = self.n_dev, self._TA
-        return (jnp.zeros((n, TA), jnp.uint32), jnp.zeros((n, TA), jnp.uint32),
-                jnp.zeros((n, TA), jnp.uint32), jnp.zeros((n, TA), jnp.uint32),
-                jnp.zeros((n, TA), _I32))
-
     def _grow_seen(self, shi, slo, ssize, new_cl=None):
         """Rebuild every shard at double (or given) capacity.  Owner
         assignment (fp_hi mod n) is capacity-independent, so keys stay on
@@ -335,9 +329,19 @@ class MeshBFSEngine:
                 hi_h[d][real], lo_h[d][real], new_cl))
         self._CL = fpset._capacity(new_cl)
         self._rebuild_programs()
-        return (jnp.stack([s.hi for s in shards]),
-                jnp.stack([s.lo for s in shards]),
-                jnp.stack([s.size for s in shards]))
+        return self._stack_sharded(shards)
+
+    def _stack_sharded(self, shards):
+        """Stack per-chip FPSet shards into (shi, slo, ssize) placed with
+        the mesh sharding — stacking device arrays directly would land
+        the whole n-chip table on one device (see sharded_full)."""
+        sh = NamedSharding(self.mesh, P("x"))
+        return (jax.device_put(np.stack([np.asarray(s.hi)
+                                         for s in shards]), sh),
+                jax.device_put(np.stack([np.asarray(s.lo)
+                                         for s in shards]), sh),
+                jax.device_put(np.stack([np.asarray(s.size)
+                                         for s in shards]), sh))
 
     def _rebuild_programs(self):
         """Re-trace chunk/ingest for a changed seen-shard shape."""
@@ -381,24 +385,39 @@ class MeshBFSEngine:
 
         CL = self._CL
         QLA = QL + self._PAD     # live rows + slice-overrun/scatter trash
-        qcur = jnp.zeros((n, QLA, sw), jnp.uint8)
-        qnext = jnp.zeros((n, QLA, sw), jnp.uint8)
-        shi = jnp.full((n, CL), SENTINEL, _U32)
-        slo = jnp.full((n, CL), SENTINEL, _U32)
-        ssize = jnp.zeros((n,), _I32)
-        next_counts = jnp.zeros((n,), _I32)
-        tbuf = self._empty_tbuf()
-        tcount = jnp.zeros((n,), _I32)
+
+        # Every device-resident buffer is allocated ALREADY SHARDED over
+        # the mesh (zeros/fills jitted with explicit out_shardings): a
+        # plain jnp.zeros would land the full n-chip array on one device
+        # — invisible on the virtual CPU mesh, an instant OOM on a real
+        # pod where per-chip capacities are sized to chip HBM.
+        def sharded_full(shape, dtype, fill=0):
+            sh = NamedSharding(self.mesh, P("x"))
+            return jax.jit(lambda: jnp.full(shape, fill, dtype),
+                           out_shardings=sh)()
+
+        qcur = sharded_full((n, QLA, sw), jnp.uint8)
+        qnext = sharded_full((n, QLA, sw), jnp.uint8)
+        shi = sharded_full((n, CL), _U32, SENTINEL)
+        slo = sharded_full((n, CL), _U32, SENTINEL)
+        ssize = sharded_full((n,), _I32)
+        next_counts = sharded_full((n,), _I32)
+        tbuf = tuple(sharded_full((n, self._TA), d)
+                     for d in (jnp.uint32, jnp.uint32, jnp.uint32,
+                               jnp.uint32, _I32))
+        tcount = sharded_full((n,), _I32)
         pending: List[np.ndarray] = []   # host pool (rows), global
         spill_next: List[np.ndarray] = []
         # Async spill (engine/bfs.py): drains ride behind compute via a
         # spare next-queue; resolved at the next drain or level boundary.
-        free_q: List = [jnp.zeros((n, QLA, sw), jnp.uint8)]
+        free_q: List = [sharded_full((n, QLA, sw), jnp.uint8)]
         inflight: List = []              # [(device array, per-chip counts)]
 
         def resolve_spill():
             while inflight:
                 arr, cnts = inflight.pop(0)
+                # _drain copies per-chip slices (np.concatenate), so no
+                # view into the recycled buffer survives.
                 spill_next.append(self._drain(np.asarray(arr), cnts))
                 free_q.append(arr)
 
@@ -445,9 +464,7 @@ class MeshBFSEngine:
                 keys_hi[owner == d].astype(np.uint32),
                 keys_lo[owner == d].astype(np.uint32), self._CL)
                 for d in range(n)]
-            shi = jnp.stack([s.hi for s in shards])
-            slo = jnp.stack([s.lo for s in shards])
-            ssize = jnp.stack([s.size for s in shards])
+            shi, slo, ssize = self._stack_sharded(shards)
             fr = np.ascontiguousarray(resume.frontier).astype(
                 ROW_DTYPE, casting="safe")
             pending = [fr]

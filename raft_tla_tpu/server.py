@@ -123,13 +123,18 @@ def _do_check(req):
     # Precedence everywhere (utils/cfg.py): request field > cfg "\* TPU:"
     # backend directive > built-in default — the backend-seeded config is
     # the base, request fields overlay only when present.
+    # A JSON null is the protocol's "unset" (the docstring's idiomatic
+    # form), so only non-null request values override the directives.
     base = engine_config_from_backend(setup)
     cfg = dataclasses.replace(
         base,
-        batch=int(req["batch"]) if "batch" in req else base.batch,
-        queue_capacity=(req["queue_capacity"] if "queue_capacity" in req
+        batch=(int(req["batch"]) if req.get("batch") is not None
+               else base.batch),
+        queue_capacity=(req["queue_capacity"]
+                        if req.get("queue_capacity") is not None
                         else base.queue_capacity),
-        seen_capacity=(req["seen_capacity"] if "seen_capacity" in req
+        seen_capacity=(req["seen_capacity"]
+                       if req.get("seen_capacity") is not None
                        else base.seen_capacity),
         max_seconds=req.get("max_seconds"),
         max_diameter=req.get("max_diameter"),
@@ -180,7 +185,7 @@ def _do_simulate(req):
     from .engine.check import initial_states
 
     setup, ident = _load_setup(req)
-    batch = (int(req["batch"]) if "batch" in req
+    batch = (int(req["batch"]) if req.get("batch") is not None
              else int(setup.backend.get("BATCH", 1024)))
     depth = int(req.get("depth", 100))
     key = (ident, batch, depth)

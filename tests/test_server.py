@@ -128,6 +128,20 @@ def test_simulate(server):
     assert resp["violation"] is None
 
 
+def test_check_mesh_engine(server):
+    # engine="mesh" routes through MeshBFSEngine on the virtual 8-device
+    # CPU mesh (conftest) and must produce the same pinned counts.
+    resp = roundtrip(server, {
+        "op": "check",
+        "cfg": os.path.join(REPO, "configs/MCraft_bounded.cfg"),
+        "engine": "mesh", "batch": 16, "max_diameter": 3,
+        "queue_capacity": 1 << 12, "seen_capacity": 1 << 15,
+        "check_deadlock": False})
+    assert resp["ok"] is True, resp
+    assert resp["distinct"] == 113
+    assert resp["levels"] == [1, 3, 18, 79]
+
+
 def test_bad_request(server):
     resp = roundtrip(server, {"op": "nope"})
     assert resp["ok"] is False
