@@ -60,6 +60,9 @@ def main(argv=None):
     c.add_argument("--resume", default=None,
                    help="checkpoint .npz to resume from, or 'auto' for the "
                         "latest one in --checkpoint-dir")
+    c.add_argument("--spill-dir", default=None,
+                   help="memory-map spilled level segments here (TLC's "
+                        "disk-backed state queue) instead of host RAM")
 
     s = sub.add_parser("simulate", help="random-trace simulation")
     common(s)
@@ -122,7 +125,8 @@ def main(argv=None):
                                      "CHECKPOINT_EVERY", 1),
             checkpoint_interval_seconds=float(
                 resolve(args.checkpoint_interval,
-                        "CHECKPOINT_INTERVAL", 60.0)))
+                        "CHECKPOINT_INTERVAL", 60.0)),
+            spill_dir=resolve(args.spill_dir, "SPILL_DIR", None))
         engine = make_engine(setup, cfgobj)
         resume = None
         if args.resume:

@@ -99,6 +99,11 @@ class EngineConfig:
     # Snapshot cost is O(seen states), so a per-level cadence is quadratic
     # over a long run; big runs should set a TLC-style time cadence (TLC
     # defaults to ~30 min between states/ checkpoints) and the CLI does.
+    #
+    # Directory for spilled level segments (TLC's disk-backed state
+    # queue): None keeps them in host RAM; a path memory-maps them to
+    # disk so frontiers larger than host memory survive (spillpool.py).
+    spill_dir: Optional[str] = None
 
 
 @dataclasses.dataclass
@@ -461,9 +466,11 @@ class BFSEngine:
         # Host-resident level segments: the part of the current level that
         # does not fit the device queue (``pending``) and next-level
         # overflow drained mid-level (``spill_next``) — TLC's disk-backed
-        # state queue, in host RAM.
-        pending: List[np.ndarray] = []
-        spill_next: List[np.ndarray] = []
+        # state queue (host RAM by default; memory-mapped files under
+        # ``spill_dir`` for frontiers beyond host memory).
+        from .spillpool import SpillPool
+        pending = SpillPool(cfg.spill_dir)
+        spill_next = SpillPool(cfg.spill_dir)
         # Async spill: a watermark drain kicks off a non-blocking D2H of
         # the full next-queue and swaps in a spare buffer, so the drain
         # overlaps the following chunks' compute; the transfer is resolved
@@ -475,10 +482,11 @@ class BFSEngine:
             while inflight:
                 arr, cnt = inflight.pop(0)
                 host = np.asarray(arr)      # completes the async copy
-                # .copy(): on CPU backends np.asarray can be a zero-copy
+                # copy=True: on CPU backends np.asarray can be a zero-copy
                 # VIEW of the device buffer, which is about to be recycled
                 # and donated — and a view would also pin all QA rows.
-                spill_next.append(host[:cnt].copy())
+                # (Disk-backed pools copy into their memmap regardless.)
+                spill_next.append(host[:cnt], copy=True)
                 free_q.append(arr)
         TA = self._TA
         tbuf = (jnp.zeros((TA,), jnp.uint32), jnp.zeros((TA,), jnp.uint32),
@@ -513,7 +521,11 @@ class BFSEngine:
                 ROW_DTYPE, casting="safe")
             # A frontier larger than the device queue resumes as device
             # rows + host segments (same split the spill path produces).
-            pending = [fr[i:i + Q] for i in range(Q, len(fr), Q)]
+            for i in range(Q, len(fr), Q):
+                # Views, not copies: the disk-backed pool copies into its
+                # memmap anyway, and the RAM pool holding views keeps the
+                # resume peak at one frontier (fr stays pinned via fr[:Q]).
+                pending.append(fr[i:i + Q])
             fr = fr[:Q]
             qcur = jnp.zeros((QA, sw), jnp.uint8).at[:len(fr)].set(
                 jnp.asarray(fr))
@@ -574,7 +586,7 @@ class BFSEngine:
                 nc = int(next_count)
                 if nc > self._QTH:      # spill: ingest adds <= B per call,
                     spill_next.append(  # so the watermark is never blown
-                        np.asarray(qnext[:nc]).copy())
+                        np.asarray(qnext[:nc]), copy=True)
                     next_count = jnp.int32(0)
                 if self._check_violation(res, vinfo):
                     break
@@ -582,10 +594,10 @@ class BFSEngine:
             # levels[] counts enqueued (constraint-passing) states per
             # level, mirroring the oracle's frontier sizes.
             res.levels.append(int(next_count)
-                              + sum(len(s) for s in spill_next))
+                              + spill_next.total_rows())
             qcur, qnext = qnext, qcur
             cur_count = int(next_count)
-            pending, spill_next = spill_next, []
+            pending, spill_next = spill_next, pending
             next_count = jnp.int32(0)
 
         # A resumed run must not rewrite the snapshot it just loaded (a
@@ -716,10 +728,10 @@ class BFSEngine:
             resolve_spill()      # level boundary: all drains must land
             res.diameter += 1
             res.levels.append(next_count_h
-                              + sum(len(s) for s in spill_next))
+                              + spill_next.total_rows())
             qcur, qnext = qnext, qcur
             cur_count = next_count_h
-            pending, spill_next = spill_next, []
+            pending, spill_next = spill_next, pending
 
         res.wall_seconds = time.time() - t0
         # Final frontier snapshot (empty when exhausted): profiling tools
@@ -813,9 +825,8 @@ class BFSEngine:
             ta = np.empty(0, np.int32)
             roots = {}
         seen_hi, seen_lo = fpset.to_host_keys(seen)
-        frontier = np.asarray(qcur[:cur_count])
-        if pending:
-            frontier = np.concatenate([frontier] + list(pending))
+        frontier, cleanup = pending.concat_with(
+            np.asarray(qcur[:cur_count]))
         ck = ckpt_mod.Checkpoint(
             dims=self.dims,
             frontier=frontier,
@@ -824,8 +835,11 @@ class BFSEngine:
             diameter=res.diameter, levels=tuple(res.levels),
             wall_seconds=wall,
             trace_fps=tf, trace_parents=tp, trace_actions=ta, roots=roots)
-        ckpt_mod.save(os.path.join(self.config.checkpoint_dir,
-                                   f"level_{res.diameter:05d}.npz"), ck)
+        try:
+            ckpt_mod.save(os.path.join(self.config.checkpoint_dir,
+                                       f"level_{res.diameter:05d}.npz"), ck)
+        finally:
+            cleanup()
 
     def _record(self, trace, tr, n_new):
         if n_new == 0 or not self.config.record_trace:

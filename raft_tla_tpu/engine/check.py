@@ -72,7 +72,8 @@ def engine_config_from_backend(setup: CheckSetup) -> EngineConfig:
                                 EngineConfig.checkpoint_every),
         checkpoint_interval_seconds=float(
             be.get("CHECKPOINT_INTERVAL",
-                   EngineConfig.checkpoint_interval_seconds)))
+                   EngineConfig.checkpoint_interval_seconds)),
+        spill_dir=be.get("SPILL_DIR"))
 
 
 def make_engine(setup: CheckSetup,

@@ -1,0 +1,155 @@
+"""Host spill pool — TLC's disk-backed state queue for level segments.
+
+The engines drain over-watermark next-level queues to host segments and
+re-upload them later (engine/bfs.py, parallel/mesh.py).  In-RAM segments
+are fine until the frontier outgrows host memory: MCraft_bounded's level
+14 alone is ~45M rows x 403 B ~ 18 GB.  TLC pages its state queue to
+disk [TLC semantics — external]; this pool does the same when given a
+directory: each segment is written to its own .npy-like raw file via
+``np.memmap`` and read back memory-mapped, so the OS page cache — not
+the Python heap — holds whatever fits and evicts the rest.
+
+``SpillPool(None)`` degrades to a plain in-RAM list (the default;
+identical behavior to the previous List[np.ndarray] plumbing).  The API
+is the small subset the engines use: append / pop(0) / len / total rows
+/ iteration (for checkpoints) / truthiness / clear.
+"""
+
+from __future__ import annotations
+
+import os
+import tempfile
+from typing import List, Optional
+
+import numpy as np
+
+
+class SpillPool:
+    """FIFO of row-array segments, RAM- or disk-backed."""
+
+    def __init__(self, directory: Optional[str] = None):
+        self.directory = directory
+        self._ram: List[np.ndarray] = []
+        self._files: List[tuple] = []     # (path, shape, dtype)
+        self._seq = 0
+        if directory is not None:
+            os.makedirs(directory, exist_ok=True)
+
+    # -- writers -------------------------------------------------------
+    def append(self, rows: np.ndarray, copy: bool = False) -> None:
+        """Queue a segment.  ``copy=True`` detaches RAM-mode segments
+        from the caller's buffer (drain paths recycle theirs); disk mode
+        always copies into the memmap, so the flag costs nothing there.
+        Default False keeps zero-copy views (resume pre-splits)."""
+        if len(rows) == 0:
+            return
+        if self.directory is None:
+            self._ram.append(np.array(rows, copy=True) if copy else rows)
+            return
+        fd, path = tempfile.mkstemp(
+            prefix=f"seg_{self._seq:06d}_", suffix=".rows",
+            dir=self.directory)
+        os.close(fd)
+        self._seq += 1
+        mm = np.memmap(path, dtype=rows.dtype, mode="w+",
+                       shape=rows.shape)
+        mm[:] = rows
+        mm.flush()
+        del mm                             # drop the writable mapping
+        self._files.append((path, rows.shape, rows.dtype))
+
+    # -- readers -------------------------------------------------------
+    def pop(self, index: int = 0) -> np.ndarray:
+        """Remove and return a segment (read-only memmap when
+        disk-backed; the file is unlinked once the array is garbage
+        collected — the open mapping keeps it readable meanwhile)."""
+        if self.directory is None:
+            return self._ram.pop(index)
+        path, shape, dtype = self._files.pop(index)
+        arr = np.memmap(path, dtype=dtype, mode="r", shape=shape)
+        os.unlink(path)                    # POSIX: mapping stays valid
+        return arr
+
+    def insert(self, index: int, rows: np.ndarray) -> None:
+        """Put a (partial) segment back at the front — the balanced
+        re-upload path splits oversized segments."""
+        if len(rows) == 0:
+            return           # disk mode: append() wrote no file to rotate
+        if self.directory is None:
+            self._ram.insert(index, rows)
+            return
+        # Re-append through a fresh file, then rotate it into place.
+        self.append(np.asarray(rows))
+        self._files.insert(index, self._files.pop())
+
+    # -- introspection -------------------------------------------------
+    def __len__(self) -> int:
+        return (len(self._ram) if self.directory is None
+                else len(self._files))
+
+    def __bool__(self) -> bool:
+        return len(self) > 0
+
+    def segments(self):
+        """Iterate segments WITHOUT consuming them (checkpoint writer)."""
+        if self.directory is None:
+            yield from self._ram
+            return
+        for path, shape, dtype in self._files:
+            yield np.memmap(path, dtype=dtype, mode="r", shape=shape)
+
+    def total_rows(self) -> int:
+        if self.directory is None:
+            return sum(len(s) for s in self._ram)
+        return sum(shape[0] for _p, shape, _d in self._files)
+
+    def clear(self) -> None:
+        self._ram.clear()
+        for path, _s, _d in self._files:
+            try:
+                os.unlink(path)
+            except OSError:
+                pass
+        self._files.clear()
+
+    def __del__(self):
+        # Runs stopped early (violation, deadlock, budgets, exceptions)
+        # drop their pools with segments still queued; without this the
+        # files leak for the life of the host — at design scale that is
+        # gigabytes per interrupted run.
+        try:
+            self.clear()
+        except Exception:
+            pass
+
+    def concat_with(self, head: np.ndarray):
+        """``head`` + every queued segment as one array (checkpoint
+        writer).  RAM pools concatenate; disk pools assemble into a
+        memmap tempfile so the result's pages are OS-evictable —
+        checkpointing a beyond-host-RAM frontier (the workload this pool
+        exists for) must not OOM.  Returns ``(array, cleanup)``; call
+        ``cleanup()`` once the array has been consumed."""
+        segs = list(self.segments())
+        if not segs:
+            return head, (lambda: None)
+        if self.directory is None:
+            return np.concatenate([head] + segs), (lambda: None)
+        total = len(head) + sum(len(s) for s in segs)
+        fd, path = tempfile.mkstemp(prefix="ckfront_", suffix=".rows",
+                                    dir=self.directory)
+        os.close(fd)
+        mm = np.memmap(path, dtype=head.dtype, mode="w+",
+                       shape=(total,) + head.shape[1:])
+        off = 0
+        for part in [head] + segs:
+            mm[off:off + len(part)] = part
+            off += len(part)
+        mm.flush()
+
+        def cleanup():
+            try:
+                os.unlink(path)
+            except OSError:
+                pass
+
+        return mm, cleanup

@@ -406,8 +406,9 @@ class MeshBFSEngine:
                      for d in (jnp.uint32, jnp.uint32, jnp.uint32,
                                jnp.uint32, _I32))
         tcount = sharded_full((n,), _I32)
-        pending: List[np.ndarray] = []   # host pool (rows), global
-        spill_next: List[np.ndarray] = []
+        from ..engine.spillpool import SpillPool
+        pending = SpillPool(cfg.spill_dir)   # host pool (rows), global
+        spill_next = SpillPool(cfg.spill_dir)
         # Async spill (engine/bfs.py): drains ride behind compute via a
         # spare next-queue; resolved at the next drain or level boundary.
         free_q: List = [sharded_full((n, QLA, sw), jnp.uint8)]
@@ -467,7 +468,11 @@ class MeshBFSEngine:
             shi, slo, ssize = self._stack_sharded(shards)
             fr = np.ascontiguousarray(resume.frontier).astype(
                 ROW_DTYPE, casting="safe")
-            pending = [fr]
+            # Pre-split into upload-sized segments (views): one giant
+            # segment would make the consume loop's remainder re-insert
+            # rewrite the whole tail per upload in disk-backed mode.
+            for i in range(0, len(fr), n * QL):
+                pending.append(fr[i:i + n * QL])
             cur_counts = np.zeros((n,), np.int64)
             res.distinct = resume.distinct
             res.generated = resume.generated
@@ -528,11 +533,11 @@ class MeshBFSEngine:
                 if self._check_violation_ingest(res, vinfo):
                     break
             res.levels.append(int(np.asarray(next_counts).sum())
-                              + sum(len(s) for s in spill_next))
+                              + spill_next.total_rows())
             qcur, qnext = qnext, qcur
             cur_counts = np.asarray(next_counts).copy()
             next_counts = jnp.zeros((n,), _I32)
-            pending, spill_next = spill_next, []
+            pending, spill_next = spill_next, pending
 
         skip_ckpt_level = resume.diameter if resume is not None else -1
         last_ckpt = time.time() if resume is not None else float("-inf")
@@ -654,11 +659,11 @@ class MeshBFSEngine:
             res.diameter += 1
             nc = np.asarray(next_counts)
             res.levels.append(int(nc.sum())
-                              + sum(len(s) for s in spill_next))
+                              + spill_next.total_rows())
             qcur, qnext = qnext, qcur
             cur_counts = nc.copy()
             next_counts = jnp.zeros((n,), _I32)
-            pending, spill_next = spill_next, []
+            pending, spill_next = spill_next, pending
 
         res.wall_seconds = time.time() - t0
         return res
@@ -709,9 +714,8 @@ class MeshBFSEngine:
             tp = np.empty(0, np.uint64)
             ta = np.empty(0, np.int32)
             roots = {}
-        frontier = self._drain(qcur, cur_counts)
-        if pending:
-            frontier = np.concatenate([frontier] + list(pending))
+        frontier, front_cleanup = pending.concat_with(
+            self._drain(qcur, cur_counts))
         hi_h, lo_h = np.asarray(shi), np.asarray(slo)
         keys_hi, keys_lo = [], []
         for d in range(self.n_dev):
@@ -729,8 +733,11 @@ class MeshBFSEngine:
             diameter=res.diameter, levels=tuple(res.levels),
             wall_seconds=wall,
             trace_fps=tf, trace_parents=tp, trace_actions=ta, roots=roots)
-        ckpt_mod.save(os.path.join(self.config.checkpoint_dir,
-                                   f"level_{res.diameter:05d}.npz"), ck)
+        try:
+            ckpt_mod.save(os.path.join(self.config.checkpoint_dir,
+                                       f"level_{res.diameter:05d}.npz"), ck)
+        finally:
+            front_cleanup()
 
     def _flush_trace(self, trace, tbuf, tcount):
         if not self.config.record_trace:

@@ -29,7 +29,8 @@ comment directives, e.g. ``\\* TPU: BATCH = 8192``.  Because they are TLC
 comments, a backend-annotated cfg still parses and runs under stock TLC
 unchanged — the cfg stays the single source of truth for both engines.
 Recognized keys: BATCH, QUEUE_CAPACITY, SEEN_CAPACITY, N_MSG_SLOTS,
-MAX_LOG, PLATFORM, CHECKPOINT_DIR, CHECKPOINT_EVERY, CHECKPOINT_INTERVAL.
+MAX_LOG, PLATFORM, CHECKPOINT_DIR, CHECKPOINT_EVERY, CHECKPOINT_INTERVAL,
+SPILL_DIR.
 Precedence everywhere: CLI flag > cfg backend key > built-in default.
 """
 
@@ -75,6 +76,7 @@ def _tokenize(text: str) -> List[str]:
 _BACKEND_KEYS = {
     "BATCH", "QUEUE_CAPACITY", "SEEN_CAPACITY", "N_MSG_SLOTS", "MAX_LOG",
     "PLATFORM", "CHECKPOINT_DIR", "CHECKPOINT_EVERY", "CHECKPOINT_INTERVAL",
+    "SPILL_DIR",
 }
 
 

@@ -198,6 +198,37 @@ def test_duration_budget_promptness():
         (res.wall_seconds, budget, eng._batch_ema)
 
 
+def test_disk_backed_spill_matches_ram(tmp_path):
+    """spill_dir memory-maps level segments to disk (TLC's disk-backed
+    state queue); a tiny device queue forces constant spills and the
+    counts must match the in-RAM run bit-for-bit.  Segment files are
+    unlinked as they are consumed/cleared."""
+    cons = build_constraint(DIMS, BOUNDS)
+    want = BFSEngine(DIMS, constraint=cons,
+                     config=small_config(max_diameter=3)).run(
+        [init_state(DIMS)])
+    spill = tmp_path / "spill"
+    eng = BFSEngine(DIMS, constraint=cons,
+                    config=small_config(batch=16, queue_capacity=16,
+                                        spill_dir=str(spill),
+                                        max_diameter=3))
+    got = eng.run([init_state(DIMS)])
+    assert got.distinct == want.distinct
+    assert got.levels == want.levels
+    assert got.generated == want.generated
+    assert list(spill.iterdir()) == []      # all segments consumed
+    # An early (budget) stop strands queued segments in the pools; they
+    # must still be cleaned up when the run ends (pool finalizer).
+    eng2 = BFSEngine(DIMS, constraint=cons,
+                     config=small_config(batch=16, queue_capacity=16,
+                                         spill_dir=str(spill),
+                                         max_diameter=4))
+    eng2.run([init_state(DIMS)])
+    import gc
+    gc.collect()
+    assert list(spill.iterdir()) == []      # no leaked segment files
+
+
 def test_progress_limiting_with_tiny_compact_buffer():
     """Results are invariant under the compacted-lane budget (ops/
     compact.py): a K too small for a whole batch's fan-out must advance

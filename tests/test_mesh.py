@@ -137,6 +137,29 @@ def test_mesh_checkpoint_resumes_on_mesh_and_single(tmp_path):
     assert got_single.levels == want.levels
 
 
+def test_mesh_disk_backed_spill_matches_ram(tmp_path):
+    """spill_dir on the mesh engine: tiny per-chip queues force constant
+    drains through the disk-backed pool (and the oversized-segment
+    re-insert path); counts must match the roomy in-RAM run, and all
+    segment files must be consumed."""
+    cons = build_constraint(DIMS, BOUNDS)
+    want = MeshBFSEngine(DIMS, constraint=cons,
+                         config=small_mesh_config(max_diameter=4)).run(
+        [init_state(DIMS)])
+    spill = tmp_path / "spill"
+    got = MeshBFSEngine(DIMS, constraint=cons,
+                        config=small_mesh_config(
+                            batch=8, queue_capacity=8, sync_every=4,
+                            spill_dir=str(spill),
+                            max_diameter=4)).run([init_state(DIMS)])
+    assert got.distinct == want.distinct
+    assert got.levels == want.levels
+    assert got.generated == want.generated
+    import gc
+    gc.collect()
+    assert list(spill.iterdir()) == []
+
+
 def test_mesh_progress_limiting_with_tiny_compact_buffer():
     """P-limiting under the pmin-replicated offset advance (ops/
     compact.py reduce_p): a compact buffer too small for a batch's
