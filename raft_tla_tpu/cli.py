@@ -40,6 +40,10 @@ def main(argv=None):
         sp.add_argument("--n-msg-slots", type=int, default=None)
         sp.add_argument("--max-log", type=int, default=None)
         sp.add_argument("--seed", type=int, default=0)
+        sp.add_argument("--engine", choices=("single", "mesh"),
+                        default="single",
+                        help="mesh = shard over all visible devices "
+                             "(TLC -workers / distributed TLC analog)")
 
     c = sub.add_parser("check", help="exhaustive BFS check")
     common(c)
@@ -127,7 +131,11 @@ def main(argv=None):
                 resolve(args.checkpoint_interval,
                         "CHECKPOINT_INTERVAL", 60.0)),
             spill_dir=resolve(args.spill_dir, "SPILL_DIR", None))
-        engine = make_engine(setup, cfgobj)
+        engine_cls = None
+        if args.engine == "mesh":
+            from .parallel.mesh import MeshBFSEngine
+            engine_cls = MeshBFSEngine
+        engine = make_engine(setup, cfgobj, engine_cls=engine_cls)
         resume = None
         if args.resume:
             if args.resume == "auto":
@@ -166,7 +174,10 @@ def main(argv=None):
 
     # simulate
     from .engine.check import resolve_constraint, resolve_invariants
-    from .engine.simulate import Simulator
+    if args.engine == "mesh":
+        from .parallel.simulate import MeshSimulator as Simulator
+    else:
+        from .engine.simulate import Simulator
     sim = Simulator(setup.dims, invariants=resolve_invariants(setup),
                     constraint=resolve_constraint(setup),
                     batch=batch, depth=args.depth)

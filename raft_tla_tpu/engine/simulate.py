@@ -54,6 +54,79 @@ class SimResult:
         return self.steps / self.wall_seconds if self.wall_seconds else 0.0
 
 
+def build_sim_chunk(dims: RaftDims, inv_fns, constraint, B: int, D: int,
+                    chunk: int):
+    """Returns ``chunk_fn(rows, roots, tstep, cur_root, abuf, key)`` — the
+    scan'd walker advance both the single-chip Simulator and the sharded
+    parallel.simulate.MeshSimulator run (each chip is just an independent
+    walker fleet with its own PRNG key; simulation never communicates)."""
+    expand = build_expand(dims)
+    pack_ok = build_pack_guard(dims)
+    inv_id = build_inv_id(inv_fns)
+
+    def body(carry, key):
+        (rows, roots, tstep, cur_root, abuf, restarts, latch) = carry
+        states = jax.vmap(unflatten_state, (0, None))(rows, dims)
+        cands, en, ovf = jax.vmap(expand)(states)
+        # uint8-row wrap counts as overflow (schema.build_pack_guard):
+        # the walker restarts rather than stepping through an aliased
+        # row.  Invariants are still checked on the pre-pack candidate.
+        ovf = ovf | (en & ~jax.vmap(jax.vmap(pack_ok))(cands))
+        # Uniform choice among enabled instances (masked categorical).
+        logits = jnp.where(en, 0.0, -jnp.inf)
+        choice = jax.random.categorical(key, logits, axis=-1)    # [B]
+        can_step = jnp.any(en, axis=1)
+        nxt = jax.tree.map(lambda a: a[jnp.arange(B), choice], cands)
+        nrows = jax.vmap(flatten_state, (0, None))(nxt, dims)
+
+        if inv_fns:
+            inv = jax.vmap(inv_id)(nxt)
+        else:
+            inv = jnp.full((B,), -1, _I32)
+        bad = can_step & (inv >= 0)
+        vf, vinv, vroot, vlen, vacts, vchoice = latch
+        any_new = jnp.any(bad) & ~vf
+        w = jnp.argmax(bad)
+        latch = (vf | jnp.any(bad),
+                 jnp.where(any_new, inv[w], vinv),
+                 jnp.where(any_new, cur_root[w], vroot),
+                 jnp.where(any_new, tstep[w], vlen),
+                 jnp.where(any_new, abuf[w], vacts),
+                 jnp.where(any_new, choice[w].astype(_I32), vchoice))
+
+        if constraint is not None:
+            cons_ok = jax.vmap(constraint)(nxt)
+        else:
+            cons_ok = jnp.ones((B,), bool)
+        # Record the action taken since the last restart.
+        abuf = abuf.at[jnp.arange(B),
+                       jnp.clip(tstep, 0, D - 1)].set(
+            jnp.where(can_step, choice.astype(_I32), -1))
+        # Restart on: dead end, overflow, constraint stop, depth bound.
+        restart = (~can_step | jnp.any(ovf, axis=1) | ~cons_ok
+                   | (tstep + 1 >= D))
+        root_idx = jax.random.randint(jax.random.fold_in(key, 1),
+                                      (B,), 0, roots.shape[0])
+        rows = jnp.where(restart[:, None], roots[root_idx],
+                         jnp.where(can_step[:, None], nrows, rows))
+        cur_root = jnp.where(restart, root_idx.astype(_I32), cur_root)
+        tstep = jnp.where(restart, 0, tstep + 1)
+        restarts = restarts + jnp.sum(restart, dtype=_I32)
+        return (rows, roots, tstep, cur_root, abuf, restarts,
+                latch), None
+
+    def chunk_fn(rows, roots, tstep, cur_root, abuf, key):
+        keys = jax.random.split(key, chunk)
+        latch0 = (jnp.bool_(False), jnp.int32(-1), jnp.int32(0),
+                  jnp.int32(0), jnp.zeros((D,), _I32), jnp.int32(-1))
+        carry0 = (rows, roots, tstep, cur_root, abuf,
+                  jnp.int32(0), latch0)
+        carry, _ = jax.lax.scan(body, carry0, keys)
+        return carry
+
+    return chunk_fn
+
+
 class Simulator:
     def __init__(self, dims: RaftDims,
                  invariants: Optional[Dict[str, Callable]] = None,
@@ -63,72 +136,10 @@ class Simulator:
         self.inv_names = list((invariants or {}).keys())
         inv_fns = list((invariants or {}).values())
         self.batch, self.depth, self.chunk = batch, depth, chunk
-        expand = build_expand(dims)
-        pack_ok = build_pack_guard(dims)
         self._sw = state_width(dims)
-        B, G, D = batch, dims.n_instances, depth
-
         inv_id = build_inv_id(inv_fns)
-
-        def body(carry, key):
-            (rows, roots, tstep, cur_root, abuf, restarts, latch) = carry
-            states = jax.vmap(unflatten_state, (0, None))(rows, dims)
-            cands, en, ovf = jax.vmap(expand)(states)
-            # uint8-row wrap counts as overflow (schema.build_pack_guard):
-            # the walker restarts rather than stepping through an aliased
-            # row.  Invariants are still checked on the pre-pack candidate.
-            ovf = ovf | (en & ~jax.vmap(jax.vmap(pack_ok))(cands))
-            # Uniform choice among enabled instances (masked categorical).
-            logits = jnp.where(en, 0.0, -jnp.inf)
-            choice = jax.random.categorical(key, logits, axis=-1)    # [B]
-            can_step = jnp.any(en, axis=1)
-            nxt = jax.tree.map(lambda a: a[jnp.arange(B), choice], cands)
-            nrows = jax.vmap(flatten_state, (0, None))(nxt, dims)
-
-            if inv_fns:
-                inv = jax.vmap(inv_id)(nxt)
-            else:
-                inv = jnp.full((B,), -1, _I32)
-            bad = can_step & (inv >= 0)
-            vf, vinv, vroot, vlen, vacts, vchoice = latch
-            any_new = jnp.any(bad) & ~vf
-            w = jnp.argmax(bad)
-            latch = (vf | jnp.any(bad),
-                     jnp.where(any_new, inv[w], vinv),
-                     jnp.where(any_new, cur_root[w], vroot),
-                     jnp.where(any_new, tstep[w], vlen),
-                     jnp.where(any_new, abuf[w], vacts),
-                     jnp.where(any_new, choice[w].astype(_I32), vchoice))
-
-            if constraint is not None:
-                cons_ok = jax.vmap(constraint)(nxt)
-            else:
-                cons_ok = jnp.ones((B,), bool)
-            # Record the action taken since the last restart.
-            abuf = abuf.at[jnp.arange(B),
-                           jnp.clip(tstep, 0, D - 1)].set(
-                jnp.where(can_step, choice.astype(_I32), -1))
-            # Restart on: dead end, overflow, constraint stop, depth bound.
-            restart = (~can_step | jnp.any(ovf, axis=1) | ~cons_ok
-                       | (tstep + 1 >= D))
-            root_idx = jax.random.randint(jax.random.fold_in(key, 1),
-                                          (B,), 0, roots.shape[0])
-            rows = jnp.where(restart[:, None], roots[root_idx],
-                             jnp.where(can_step[:, None], nrows, rows))
-            cur_root = jnp.where(restart, root_idx.astype(_I32), cur_root)
-            tstep = jnp.where(restart, 0, tstep + 1)
-            restarts = restarts + jnp.sum(restart, dtype=_I32)
-            return (rows, roots, tstep, cur_root, abuf, restarts,
-                    latch), None
-
-        def chunk_fn(rows, roots, tstep, cur_root, abuf, key):
-            keys = jax.random.split(key, self.chunk)
-            latch0 = (jnp.bool_(False), jnp.int32(-1), jnp.int32(0),
-                      jnp.int32(0), jnp.zeros((D,), _I32), jnp.int32(-1))
-            carry0 = (rows, roots, tstep, cur_root, abuf,
-                      jnp.int32(0), latch0)
-            carry, _ = jax.lax.scan(body, carry0, keys)
-            return carry
+        chunk_fn = build_sim_chunk(dims, inv_fns, constraint, batch, depth,
+                                   chunk)
 
         def roots_inv(batch):
             # Takes the *unpacked* int32 StateBatch, not packed rows: uint8
@@ -140,17 +151,16 @@ class Simulator:
 
         self._chunk = jax.jit(chunk_fn, donate_argnums=(0, 4))
         self._roots_inv = jax.jit(roots_inv)
-        self._expand1 = jax.jit(expand)
+        self._expand1 = jax.jit(build_expand(dims))
 
     # ------------------------------------------------------------------
-    def run(self, roots: List[PyState], num_steps: int, seed: int = 0,
-            max_seconds: Optional[float] = None) -> SimResult:
-        dims, B, D = self.dims, self.batch, self.depth
-        res = SimResult()
-        t0 = time.time()
+    def _prepare_roots(self, roots: List[PyState], res: SimResult, t0):
+        """Shared root handling (single-chip and mesh): TLC checks
+        invariants on initial states too — a violating root ends the run
+        immediately; otherwise reject silently-aliasing roots and return
+        the packed root rows."""
+        dims = self.dims
         encoded = [encode_state(s, dims) for s in roots]
-        # TLC checks invariants on initial states too (so does the BFS
-        # engine's ingest path); a violating root ends the run immediately.
         rinv = np.asarray(self._roots_inv(stack_states(encoded)))
         if (rinv >= 0).any():
             idx = int(np.argmax(rinv >= 0))
@@ -158,10 +168,19 @@ class Simulator:
             res.violation_trace = [(-1, roots[idx])]
             res.violation_invariant = self.inv_names[int(rinv[idx])]
             res.wall_seconds = time.time() - t0
-            return res
-        for e in encoded:        # reject silently-aliasing roots
+            return None
+        for e in encoded:
             check_packable(e)
-        roots_np = np.stack([flatten_state(e, dims) for e in encoded])
+        return np.stack([flatten_state(e, dims) for e in encoded])
+
+    def run(self, roots: List[PyState], num_steps: int, seed: int = 0,
+            max_seconds: Optional[float] = None) -> SimResult:
+        dims, B, D = self.dims, self.batch, self.depth
+        res = SimResult()
+        t0 = time.time()
+        roots_np = self._prepare_roots(roots, res, t0)
+        if roots_np is None:
+            return res
         roots_j = jnp.asarray(roots_np)
         key = jax.random.PRNGKey(seed)
         key, sub = jax.random.split(key)

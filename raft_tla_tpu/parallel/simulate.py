@@ -1,0 +1,116 @@
+"""Mesh-sharded simulation — TLC's ``-simulate`` worker pool on a device
+mesh.
+
+Simulation is embarrassingly parallel (SURVEY §3.4: independent random
+walkers, no seen-set, no communication), so the mesh version is simply n
+independent walker fleets — the same scan'd chunk program as the
+single-chip Simulator (engine/simulate.py build_sim_chunk), shard_map'd
+over a 1-D mesh with a distinct PRNG key per chip.  Violation latches
+are per-chip; the host picks the first latched chip and replays its
+(root, action sequence) through the expand kernel exactly like the
+single-chip path.  Aggregate throughput scales linearly with chips —
+this is the TLC ``-workers N`` analog for simulation mode.
+"""
+
+from __future__ import annotations
+
+import time
+from functools import partial
+from typing import Callable, Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..engine.simulate import SimResult, Simulator, build_sim_chunk
+from ..models.dims import RaftDims
+from ..models.pystate import PyState
+
+
+class MeshSimulator:
+    """n independent walker fleets of ``batch`` walkers each."""
+
+    def __init__(self, dims: RaftDims,
+                 invariants: Optional[Dict[str, Callable]] = None,
+                 constraint: Optional[Callable] = None,
+                 batch: int = 256, depth: int = 100, chunk: int = 128,
+                 devices=None):
+        self.dims = dims
+        self.inv_names = list((invariants or {}).keys())
+        inv_fns = list((invariants or {}).values())
+        self.batch, self.depth, self.chunk = batch, depth, chunk
+        devices = devices if devices is not None else jax.devices()
+        self.n_dev = n = len(devices)
+        self.mesh = Mesh(np.asarray(devices), ("x",))
+        chunk_fn = build_sim_chunk(dims, inv_fns, constraint, batch, depth,
+                                   chunk)
+
+        def sharded(rows, roots, tstep, cur_root, abuf, keys):
+            # Leading device axis of size 1 inside shard_map.
+            carry = chunk_fn(rows[0], roots, tstep[0], cur_root[0],
+                             abuf[0], keys[0])
+            rows_o, _roots, tstep_o, cur_root_o, abuf_o, restarts, \
+                latch = carry
+            vf, vinv, vroot, vlen, vacts, vchoice = latch
+            return (rows_o[None], tstep_o[None], cur_root_o[None],
+                    abuf_o[None], restarts[None], vf[None], vinv[None],
+                    vroot[None], vlen[None], vacts[None], vchoice[None])
+
+        shard = partial(jax.shard_map, mesh=self.mesh, check_vma=False)
+        sx, rep = P("x"), P()
+        self._chunk = jax.jit(shard(
+            sharded,
+            in_specs=(sx, rep, sx, sx, sx, sx),
+            out_specs=(sx,) * 11), donate_argnums=(0, 4))
+
+        # Root checking + replay reuse the single-chip machinery (its
+        # chunk program is jit-lazy and never traced here — only
+        # _roots_inv, _reconstruct, and _prepare_roots are used).
+        self._single = Simulator(dims, invariants=invariants,
+                                 constraint=constraint, batch=batch,
+                                 depth=depth, chunk=chunk)
+
+    # ------------------------------------------------------------------
+    def run(self, roots: List[PyState], num_steps: int, seed: int = 0,
+            max_seconds: Optional[float] = None) -> SimResult:
+        dims, n, B, D = self.dims, self.n_dev, self.batch, self.depth
+        res = SimResult()
+        t0 = time.time()
+        roots_np = self._single._prepare_roots(roots, res, t0)
+        if roots_np is None:
+            return res
+        roots_j = jnp.asarray(roots_np)
+
+        sh = NamedSharding(self.mesh, P("x"))
+        key = jax.random.PRNGKey(seed)
+        key, sub = jax.random.split(key)
+        start = np.asarray(
+            jax.random.randint(sub, (n, B), 0, len(roots))).astype(np.int32)
+        rows = jax.device_put(roots_np[start], sh)
+        cur_root = jax.device_put(start, sh)
+        tstep = jax.device_put(np.zeros((n, B), np.int32), sh)
+        abuf = jax.device_put(np.zeros((n, B, D), np.int32), sh)
+        res.traces = n * B
+
+        while res.steps < num_steps:
+            key, sub = jax.random.split(key)
+            keys = jax.device_put(
+                np.asarray(jax.random.split(sub, n)), sh)
+            out = self._chunk(rows, roots_j, tstep, cur_root, abuf, keys)
+            (rows, tstep, cur_root, abuf, restarts, vf, vinv, vroot,
+             vlen, vacts, vchoice) = out
+            res.steps += n * B * self.chunk
+            res.traces += int(np.asarray(restarts).sum())
+            vf_h = np.asarray(vf)
+            if vf_h.any():
+                d = int(np.argmax(vf_h))
+                self._single._reconstruct(
+                    res, roots, int(np.asarray(vinv)[d]),
+                    int(np.asarray(vroot)[d]), int(np.asarray(vlen)[d]),
+                    np.asarray(vacts)[d], int(np.asarray(vchoice)[d]))
+                break
+            if max_seconds is not None and time.time() - t0 > max_seconds:
+                break
+        res.wall_seconds = time.time() - t0
+        return res

@@ -55,3 +55,35 @@ def test_simulation_checks_root_states():
     assert res.violation_invariant == "TypeOK"
     assert res.violation_state == bad_root
     assert res.violation_trace == [(-1, bad_root)]
+
+
+def test_mesh_simulator_runs_and_finds_violation():
+    """MeshSimulator: n independent walker fleets on the virtual 8-device
+    mesh.  Clean model runs clean; the seeded near-election model latches
+    a NoLeader violation on some chip and replays it to a legal trace."""
+    from raft_tla_tpu.parallel.simulate import MeshSimulator
+    cons = build_constraint(
+        DIMS, Bounds(max_term=2, max_log_len=1, max_msg_count=1))
+    sim = MeshSimulator(DIMS, constraint=cons, batch=8, depth=8, chunk=16)
+    res = sim.run([init_state(DIMS)], num_steps=sim.n_dev * 8 * 16, seed=1)
+    assert res.steps == sim.n_dev * 8 * 16
+    assert res.traces > sim.n_dev * 8
+    assert res.violation_invariant is None
+
+    s0 = init_state(DIMS).replace(
+        role=(1, 0, 0), current_term=(2, 2, 2), voted_for=(1, 1, 1),
+        votes_responded=(0b001, 0, 0), votes_granted=(0b001, 0, 0),
+        messages=frozenset({((1, 1, 0, 2, 1, ()), 1)}))
+    sim = MeshSimulator(
+        DIMS, invariants={"NoLeader": lambda st: jnp.all(st.role != LEADER)},
+        constraint=build_constraint(
+            DIMS, Bounds(max_term=3, max_log_len=1, max_msg_count=1)),
+        batch=16, depth=16, chunk=32)
+    res = sim.run([s0], num_steps=sim.n_dev * 16 * 32 * 8, seed=0)
+    assert res.violation_invariant == "NoLeader"
+    assert LEADER in res.violation_state.role
+    trace = res.violation_trace
+    assert trace[0][1] == s0
+    assert trace[-1][1] == res.violation_state
+    for (g_prev, s_prev), (g, s_next) in zip(trace, trace[1:]):
+        assert s_next in orc.successor_set(s_prev, DIMS)
