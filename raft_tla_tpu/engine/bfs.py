@@ -640,8 +640,14 @@ class BFSEngine:
                             res.stop_reason = "duration_budget"
                             break
                         if self._batch_ema:
+                            # Half the remaining budget per call: one
+                            # call's overshoot is then bounded by the
+                            # estimator error over HALF the window, at
+                            # the cost of at most a couple extra host
+                            # syncs right before the deadline.
                             allowed = max(1, min(
-                                self._CH, int(remaining / self._batch_ema)))
+                                self._CH,
+                                int(remaining / (2 * self._batch_ema))))
                         else:
                             # No cost estimate yet: probe with one batch
                             # so the first call can't blow the deadline

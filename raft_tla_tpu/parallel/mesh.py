@@ -569,9 +569,10 @@ class MeshBFSEngine:
                             res.stop_reason = "duration_budget"
                             break
                         if self._batch_ema:
+                            # Half-window sizing (engine/bfs.py rationale)
                             allowed = max(1, min(
                                 self._CH,
-                                int(remaining / self._batch_ema)))
+                                int(remaining / (2 * self._batch_ema))))
                         else:
                             allowed = 1    # no estimate yet: probe batch
                                            # (engine/bfs.py rationale)

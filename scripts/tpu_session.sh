@@ -1,0 +1,45 @@
+#!/bin/bash
+# One-shot TPU measurement session (round-3 performance evidence).
+# Run when the TPU tunnel is alive; everything lands in artifacts/.
+#
+#   bash scripts/tpu_session.sh [budget_seconds_for_northstar]
+#
+# Stages (each skipped gracefully if a prior one shows the tunnel dead):
+#   1. probe           - fail fast if the tunnel is wedged
+#   2. profile_step    - per-stage device timings (the round-3 instrument)
+#   3. bench           - the driver metric (BENCH_SECONDS=60)
+#   4. north star      - raft5/TPUraft.cfg on one chip, checkpoint+spill,
+#                        budgeted; level profile recorded
+#   5. simulation      - BASELINE configs[3] scale (capped by time budget)
+set -u
+cd "$(dirname "$0")/.."
+mkdir -p artifacts
+NS_BUDGET="${1:-900}"
+
+echo "== 1. probe =="
+if ! timeout 180 python -c "import jax; d=jax.devices(); assert d[0].platform != 'cpu', d; print('tpu ok:', d)"; then
+    echo "TPU tunnel unavailable; aborting session."
+    exit 1
+fi
+
+echo "== 2. profile_step (B=2048) =="
+timeout 1200 python scripts/profile_step.py 2048 2>&1 | grep -v WARNING \
+    | tee artifacts/profile_step_tpu.txt
+
+echo "== 3. bench (60 s budget) =="
+BENCH_SECONDS=60 timeout 900 python bench.py 2>&1 | grep -v WARNING \
+    | tee artifacts/bench_tpu.json
+
+echo "== 4. north-star attempt (budget ${NS_BUDGET}s, ckpt+spill) =="
+timeout $((NS_BUDGET + 600)) python -m raft_tla_tpu check \
+    configs/TPUraft.cfg --max-seconds "${NS_BUDGET}" --no-trace \
+    --checkpoint-dir artifacts/ns_ckpt --spill-dir artifacts/ns_spill \
+    2>&1 | grep -v WARNING | tee artifacts/northstar_tpu.txt
+
+echo "== 5. simulation at scale (300 s cap) =="
+timeout 600 python -m raft_tla_tpu simulate configs/MCraft_bounded.cfg \
+    --batch 8192 --num-steps 134217728 --max-seconds 300 \
+    2>&1 | grep -v WARNING | tee artifacts/simulate_tpu.txt
+
+echo "== session complete; artifacts/ =="
+ls -la artifacts/

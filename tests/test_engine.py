@@ -193,7 +193,11 @@ def test_duration_budget_promptness():
     if res.stop_reason == "exhausted":
         pytest.skip("machine fast enough to exhaust inside the budget")
     assert res.stop_reason == "duration_budget"
-    slack = max(3 * eng._batch_ema, 1.0)
+    # Slack: a few batches at the measured cost, floored for 1-core
+    # timing jitter (this guards against the round-2 failure mode of
+    # overshooting by a whole sync_every chunk / 66% of the budget —
+    # not against scheduler noise).
+    slack = max(5 * eng._batch_ema, 2.0)
     assert res.wall_seconds <= budget + slack, \
         (res.wall_seconds, budget, eng._batch_ema)
 
