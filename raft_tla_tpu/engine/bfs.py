@@ -457,11 +457,17 @@ class BFSEngine:
                     trace.roots.setdefault(fp, s)
 
         # Queues carry PAD rows past Q: slice overrun + scatter trash
-        # (see the capacity comment in __init__).
+        # (see the capacity comment in __init__).  Every queue buffer is
+        # COMMITTED to the device explicitly: the jit cache keys on arg
+        # placement, so an uncommitted jnp.zeros entering _chunk (e.g.
+        # the async-spill spare at the first swap) retraces and RECOMPILES
+        # the whole chunk program mid-run — ~10 s of silently charged
+        # wall time on a cold compilation cache.
+        dev = jax.devices()[0]
         QA = Q + self._PAD
-        qcur = jnp.zeros((QA, sw), jnp.uint8)
-        qnext = jnp.zeros((QA, sw), jnp.uint8)
-        seen = fpset.empty(self._seen_cap)
+        qcur = jax.device_put(jnp.zeros((QA, sw), jnp.uint8), dev)
+        qnext = jax.device_put(jnp.zeros((QA, sw), jnp.uint8), dev)
+        seen = jax.device_put(fpset.empty(self._seen_cap), dev)
         next_count = jnp.int32(0)
         # Host-resident level segments: the part of the current level that
         # does not fit the device queue (``pending``) and next-level
@@ -475,7 +481,7 @@ class BFSEngine:
         # the full next-queue and swaps in a spare buffer, so the drain
         # overlaps the following chunks' compute; the transfer is resolved
         # (and the buffer recycled) at the next drain or level boundary.
-        free_q: List = [jnp.zeros((QA, sw), jnp.uint8)]
+        free_q: List = [jax.device_put(jnp.zeros((QA, sw), jnp.uint8), dev)]
         inflight: List = []        # [(device array, row count)]
 
         def resolve_spill():
@@ -489,9 +495,10 @@ class BFSEngine:
                 spill_next.append(host[:cnt], copy=True)
                 free_q.append(arr)
         TA = self._TA
-        tbuf = (jnp.zeros((TA,), jnp.uint32), jnp.zeros((TA,), jnp.uint32),
-                jnp.zeros((TA,), jnp.uint32), jnp.zeros((TA,), jnp.uint32),
-                jnp.zeros((TA,), _I32))
+        tbuf = jax.device_put(
+            (jnp.zeros((TA,), jnp.uint32), jnp.zeros((TA,), jnp.uint32),
+             jnp.zeros((TA,), jnp.uint32), jnp.zeros((TA,), jnp.uint32),
+             jnp.zeros((TA,), _I32)), dev)
 
         # Warm-up: run both programs once with empty inputs (no semantic
         # effect: all-invalid masks insert nothing, zero-trip chunk) so XLA
@@ -503,6 +510,15 @@ class BFSEngine:
         qnext, next_count, seen = out[0], out[1], out[2]
         out = self._chunk(qcur, jnp.int32(0), jnp.int32(0),
                           qnext, next_count, seen, tbuf, jnp.int32(0),
+                          jnp.int32(self._CH))
+        qnext, seen, tbuf = out[0], out[1], out[2]
+        # Second zero-trip call with the first call's OUTPUTS: jit caches
+        # key on argument placement, and outputs carry committed shardings
+        # that fresh allocations may not — without this fixpoint call, the
+        # first real batch silently recompiles the whole chunk program
+        # (~10 s) inside the budget window.
+        out = self._chunk(qcur, jnp.int32(0), jnp.int32(0),
+                          qnext, jnp.int32(0), seen, tbuf, jnp.int32(0),
                           jnp.int32(self._CH))
         qnext, seen, tbuf = out[0], out[1], out[2]
         t0 = time.time()
@@ -527,8 +543,9 @@ class BFSEngine:
                 # resume peak at one frontier (fr stays pinned via fr[:Q]).
                 pending.append(fr[i:i + Q])
             fr = fr[:Q]
-            qcur = jnp.zeros((QA, sw), jnp.uint8).at[:len(fr)].set(
-                jnp.asarray(fr))
+            qcur = jax.device_put(
+                jnp.zeros((QA, sw), jnp.uint8).at[:len(fr)].set(
+                    jnp.asarray(fr)), dev)
             cur_count = len(fr)
             res.distinct = resume.distinct
             res.generated = resume.generated
@@ -626,6 +643,15 @@ class BFSEngine:
             # segments: first the device-resident rows, then any host
             # segments left by the previous level's spill.
             next_count_h = 0
+            # Budgeted runs slow-start each level: batch cost is
+            # data-dependent (probe-round early exits, frontier density)
+            # and roughly homogeneous WITHIN a level but can jump 100x
+            # between levels — so the first call of a level probes with
+            # two batches (amortizing the host round-trip) to re-measure,
+            # then the ramp doubles under the remaining-time bound.
+            # Overshoot is thereby bounded by ~two batches at the current
+            # level's cost.
+            calls_in_level = 0
             while True:
                 offset = 0
                 while offset < cur_count:
@@ -640,19 +666,22 @@ class BFSEngine:
                             res.stop_reason = "duration_budget"
                             break
                         if self._batch_ema:
-                            # Half the remaining budget per call: one
-                            # call's overshoot is then bounded by the
-                            # estimator error over HALF the window, at
-                            # the cost of at most a couple extra host
-                            # syncs right before the deadline.
+                            # Half the remaining budget per call, capped
+                            # by the per-level slow-start ramp.  The ramp
+                            # starts at 2 batches so the per-call host
+                            # round-trip amortizes over the probe and
+                            # does not lock the (jump-up, decay-slow)
+                            # estimator at RTT-dominated cost.
                             allowed = max(1, min(
                                 self._CH,
-                                int(remaining / (2 * self._batch_ema))))
+                                int(remaining / (2 * self._batch_ema)),
+                                2 << min(calls_in_level, 9)))
                         else:
                             # No cost estimate yet: probe with one batch
                             # so the first call can't blow the deadline
                             # by a whole sync_every chunk.
                             allowed = 1
+                    calls_in_level += 1
                     t_call = time.time()
                     out = self._chunk(qcur, jnp.int32(cur_count),
                                       jnp.int32(offset), qnext,

@@ -453,6 +453,13 @@ class MeshBFSEngine:
                           qnext, next_counts, shi, slo, ssize, tbuf,
                           tcount, jnp.int32(self._CH), jnp.int32(0))
         qnext, next_counts, shi, slo, ssize, tbuf = out[:6]
+        # Placement-fixpoint second call (engine/bfs.py warm-up rationale):
+        # free when outputs already carry the input shardings, and
+        # pre-compiles the output-placement variant when they don't.
+        out = self._chunk(qcur, jnp.zeros((n,), _I32), jnp.int32(0),
+                          qnext, next_counts, shi, slo, ssize, tbuf,
+                          tcount, jnp.int32(self._CH), jnp.int32(0))
+        qnext, next_counts, shi, slo, ssize, tbuf = out[:6]
         t0 = time.time()
         self._batch_ema = 0.0
 
@@ -557,7 +564,9 @@ class MeshBFSEngine:
                 res.stop_reason = "diameter_budget"
                 break
             # Level loop over segments: device-resident rows first, then
-            # host-pool segments (balanced re-uploads).
+            # host-pool segments (balanced re-uploads).  Budgeted runs
+            # slow-start each level (engine/bfs.py rationale).
+            calls_in_level = 0
             while True:
                 offset = 0
                 max_count = int(cur_counts.max()) if len(cur_counts) else 0
@@ -569,13 +578,16 @@ class MeshBFSEngine:
                             res.stop_reason = "duration_budget"
                             break
                         if self._batch_ema:
-                            # Half-window sizing (engine/bfs.py rationale)
+                            # Half-window sizing + per-level slow-start
+                            # (engine/bfs.py rationale)
                             allowed = max(1, min(
                                 self._CH,
-                                int(remaining / (2 * self._batch_ema))))
+                                int(remaining / (2 * self._batch_ema)),
+                                2 << min(calls_in_level, 9)))
                         else:
                             allowed = 1    # no estimate yet: probe batch
                                            # (engine/bfs.py rationale)
+                    calls_in_level += 1
                     t_call = time.time()
                     out = self._chunk(
                         qcur, jnp.asarray(cur_counts, _I32),

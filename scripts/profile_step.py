@@ -102,23 +102,26 @@ def main():
         return jax.tree.map(lambda a: a.sum(), cands), en.sum()
 
     @jax.jit
-    def part_fp(rows):
-        states = jax.vmap(unflatten_state, (0, None))(rows, dims)
-        cands, en, ovf = jax.vmap(expand)(states)
-        cflat = jax.tree.map(
-            lambda a: a.reshape((BG,) + a.shape[2:]), cands)
-        fph, fpl = jax.vmap(fingerprint)(cflat)
-        return fph.sum(), fpl.sum(), en.sum()
-
-    @jax.jit
     def part_compact(rows):
         states = jax.vmap(unflatten_state, (0, None))(rows, dims)
         cands, en, ovf = jax.vmap(expand)(states)
         cflat = jax.tree.map(
             lambda a: a.reshape((BG,) + a.shape[2:]), cands)
-        fph, fpl = jax.vmap(fingerprint)(cflat)
         _P, _total, lane_id, kvalid = compactor(en)
-        return (cflat, fph[lane_id], fpl[lane_id], lane_id, kvalid)
+        return (cflat, lane_id, kvalid)
+
+    @jax.jit
+    def part_fp(rows):
+        # fingerprint AFTER compaction (engine/chunk.py order): gather K
+        # candidate structs, hash those K lanes only.
+        states = jax.vmap(unflatten_state, (0, None))(rows, dims)
+        cands, en, ovf = jax.vmap(expand)(states)
+        cflat = jax.tree.map(
+            lambda a: a.reshape((BG,) + a.shape[2:]), cands)
+        _P, _total, lane_id, kvalid = compactor(en)
+        kstates = jax.tree.map(lambda a: a[lane_id], cflat)
+        kh, kl = jax.vmap(fingerprint)(kstates)
+        return (cflat, kh, kl, lane_id, kvalid)
 
     @jax.jit
     def part_insert(seen, kh, kl, kvalid):
@@ -138,9 +141,9 @@ def main():
 
     rows = qcur[:B]
     bench("expand", part_expand, rows)
-    bench("expand + fingerprint (B*G)", part_fp, rows)
+    bench("expand + compact (K lanes)", part_compact, rows)
     _, (cflat, kh, kl, lane_id, kvalid) = bench(
-        "expand + fp + compact (K lanes)", part_compact, rows)
+        "expand + compact + fingerprint (K)", part_fp, rows)
     seen = fpset.empty(cfg.seen_capacity)
     bench("fpset.insert (K keys: sort + probes)", part_insert, seen, kh, kl,
           kvalid)

@@ -16,30 +16,42 @@ cd "$(dirname "$0")/.."
 mkdir -p artifacts
 NS_BUDGET="${1:-900}"
 
+probe() {
+    timeout 180 python -c \
+        "import jax; assert jax.devices()[0].platform != 'cpu'" \
+        2>/dev/null
+}
+
 echo "== 1. probe =="
-if ! timeout 180 python -c "import jax; d=jax.devices(); assert d[0].platform != 'cpu', d; print('tpu ok:', d)"; then
+if ! probe; then
     echo "TPU tunnel unavailable; aborting session."
     exit 1
 fi
+echo "tpu ok"
 
 echo "== 2. profile_step (B=2048) =="
-timeout 1200 python scripts/profile_step.py 2048 2>&1 | grep -v WARNING \
-    | tee artifacts/profile_step_tpu.txt
+timeout 1200 python scripts/profile_step.py 2048 \
+    2> artifacts/profile_step_tpu.log | tee artifacts/profile_step_tpu.txt
 
 echo "== 3. bench (60 s budget) =="
-BENCH_SECONDS=60 timeout 900 python bench.py 2>&1 | grep -v WARNING \
-    | tee artifacts/bench_tpu.json
+# stdout only into the .json — bench prints exactly one JSON line there;
+# stderr (fallback notices, absl logs) goes to the .log.
+probe || { echo "tunnel died before bench; stopping"; exit 1; }
+BENCH_SECONDS=60 timeout 900 python bench.py \
+    2> artifacts/bench_tpu.log | tee artifacts/bench_tpu.json
 
 echo "== 4. north-star attempt (budget ${NS_BUDGET}s, ckpt+spill) =="
+probe || { echo "tunnel died before north star; stopping"; exit 1; }
 timeout $((NS_BUDGET + 600)) python -m raft_tla_tpu check \
     configs/TPUraft.cfg --max-seconds "${NS_BUDGET}" --no-trace \
     --checkpoint-dir artifacts/ns_ckpt --spill-dir artifacts/ns_spill \
-    2>&1 | grep -v WARNING | tee artifacts/northstar_tpu.txt
+    2> artifacts/northstar_tpu.log | tee artifacts/northstar_tpu.txt
 
 echo "== 5. simulation at scale (300 s cap) =="
+probe || { echo "tunnel died before simulate; stopping"; exit 1; }
 timeout 600 python -m raft_tla_tpu simulate configs/MCraft_bounded.cfg \
     --batch 8192 --num-steps 134217728 --max-seconds 300 \
-    2>&1 | grep -v WARNING | tee artifacts/simulate_tpu.txt
+    2> artifacts/simulate_tpu.log | tee artifacts/simulate_tpu.txt
 
 echo "== session complete; artifacts/ =="
 ls -la artifacts/
