@@ -76,11 +76,11 @@ def main():
     here = os.path.dirname(os.path.abspath(__file__))
     setup = load_config(os.path.join(here, "configs/MCraft_bounded.cfg"))
     cfg = EngineConfig(
-        batch=2048 if on_accel else 128,
+        batch=2048 if on_accel else 512,
         # None => sized from the chip's reported HBM; the frontier spills
         # to host RAM past that, so no level size can crash the run.
-        queue_capacity=None if on_accel else 1 << 15,
-        seen_capacity=None if on_accel else 1 << 18,
+        queue_capacity=None if on_accel else 1 << 19,
+        seen_capacity=None if on_accel else 1 << 21,
         check_deadlock=False,
         record_trace=False,          # raw engine throughput (trace store is
         max_seconds=BENCH_SECONDS)   # host-side; C++ store tracked separately)
