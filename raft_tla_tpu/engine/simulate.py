@@ -185,10 +185,15 @@ class Simulator:
         key = jax.random.PRNGKey(seed)
         key, sub = jax.random.split(key)
         start = jax.random.randint(sub, (B,), 0, len(roots)).astype(_I32)
-        rows = roots_j[start]
-        cur_root = start
-        tstep = jnp.zeros((B,), _I32)
-        abuf = jnp.zeros((B, D), _I32)
+        # Initial walker arrays are COMMITTED to the device: the jit
+        # cache keys on placement, and uncommitted first-call inputs vs
+        # committed carry outputs would recompile the whole scan program
+        # on the second call (engine/bfs.py run() rationale).
+        dev = jax.devices()[0]
+        rows = jax.device_put(roots_j[start], dev)
+        cur_root = jax.device_put(start, dev)
+        tstep = jax.device_put(jnp.zeros((B,), _I32), dev)
+        abuf = jax.device_put(jnp.zeros((B, D), _I32), dev)
         res.traces = B
 
         while res.steps < num_steps:
