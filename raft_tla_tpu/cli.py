@@ -40,10 +40,11 @@ def main(argv=None):
         sp.add_argument("--n-msg-slots", type=int, default=None)
         sp.add_argument("--max-log", type=int, default=None)
         sp.add_argument("--seed", type=int, default=0)
-        sp.add_argument("--engine", choices=("single", "mesh"),
-                        default="single",
-                        help="mesh = shard over all visible devices "
-                             "(TLC -workers / distributed TLC analog)")
+        sp.add_argument("--engine", choices=("single", "mesh", "auto"),
+                        default="auto",
+                        help="mesh = shard over all visible devices (TLC "
+                             "-workers / distributed TLC analog); auto = "
+                             "mesh iff >1 accelerator device (default)")
 
     c = sub.add_parser("check", help="exhaustive BFS check")
     common(c)
@@ -131,7 +132,7 @@ def main(argv=None):
                 resolve(args.checkpoint_interval,
                         "CHECKPOINT_INTERVAL", 60.0)),
             spill_dir=resolve(args.spill_dir, "SPILL_DIR", None))
-        engine_cls = None
+        engine_cls = args.engine if args.engine == "auto" else None
         if args.engine == "mesh":
             from .parallel.mesh import MeshBFSEngine
             engine_cls = MeshBFSEngine
@@ -174,7 +175,12 @@ def main(argv=None):
 
     # simulate
     from .engine.check import resolve_constraint, resolve_invariants
-    if args.engine == "mesh":
+    use_mesh = args.engine == "mesh"
+    if args.engine == "auto":
+        import jax
+        devs = jax.devices()
+        use_mesh = len(devs) > 1 and devs[0].platform != "cpu"
+    if use_mesh:
         from .parallel.simulate import MeshSimulator as Simulator
     else:
         from .engine.simulate import Simulator

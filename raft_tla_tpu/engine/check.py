@@ -81,9 +81,19 @@ def make_engine(setup: CheckSetup,
                 engine_cls=None):
     """Build a checker engine with the cfg-file fallbacks applied
     (CHECK_DEADLOCK, StopAfter budgets).  ``engine_cls`` selects the
-    implementation — BFSEngine (default) or parallel.mesh.MeshBFSEngine —
-    so every entry point resolves the config identically."""
+    implementation — BFSEngine (default), parallel.mesh.MeshBFSEngine,
+    or the string ``"auto"`` (mesh iff running on more than one
+    accelerator device, e.g. a v5e-8 slice) — so every entry point
+    resolves the engine and config identically."""
     import dataclasses as _dc
+    if engine_cls == "auto":
+        import jax
+        devs = jax.devices()
+        if len(devs) > 1 and devs[0].platform != "cpu":
+            from ..parallel.mesh import MeshBFSEngine
+            engine_cls = MeshBFSEngine
+        else:
+            engine_cls = None
     base = engine_config or engine_config_from_backend(setup)
     cfg = _dc.replace(          # never mutate the caller's config
         base,

@@ -152,6 +152,8 @@ def _do_check(req):
         if req.get("engine") == "mesh":
             from .parallel.mesh import MeshBFSEngine
             engine_cls = MeshBFSEngine
+        elif req.get("engine") == "auto":
+            engine_cls = "auto"
         # make_engine applies the cfg-file fallbacks (CHECK_DEADLOCK,
         # StopAfter) identically for both engine classes.
         engine = make_engine(setup, cfg, engine_cls=engine_cls)
