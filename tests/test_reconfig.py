@@ -226,3 +226,29 @@ def test_engine_matches_oracle_on_reconfig3():
     assert res.violation is None
     assert res.distinct == oracle_res.distinct_states
     assert res.levels[:4] == oracle_res.levels[:4]
+
+
+def test_mesh_engine_matches_single_on_reconfig3():
+    """The joint-consensus variant through the mesh engine (its extra
+    kernels flow through the shared chunk body and the owner-routed
+    dedup): counts must match the single-chip engine exactly."""
+    import os
+
+    from raft_tla_tpu.engine.bfs import EngineConfig
+    from raft_tla_tpu.engine.check import initial_states, make_engine
+    from raft_tla_tpu.parallel.mesh import MeshBFSEngine
+    from raft_tla_tpu.utils.cfg import load_config
+
+    here = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    setup = load_config(os.path.join(here, "configs/reconfig3.cfg"))
+    want = make_engine(setup, EngineConfig(
+        batch=128, queue_capacity=1 << 14, seen_capacity=1 << 16,
+        record_trace=False, max_diameter=3)).run(initial_states(setup))
+    got = make_engine(setup, EngineConfig(
+        batch=16, queue_capacity=1 << 12, seen_capacity=1 << 15,
+        record_trace=False, max_diameter=3),
+        engine_cls=MeshBFSEngine).run(initial_states(setup))
+    assert got.distinct == want.distinct
+    assert got.levels == want.levels
+    assert got.generated == want.generated
+    assert got.violation is None
