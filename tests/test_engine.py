@@ -308,6 +308,29 @@ def test_spill_to_host_matches_unspilled():
     assert got.diameter == want.diameter
 
 
+def test_ingest_spill_with_many_roots():
+    """Root INGEST can overflow the device queue too (a k=3 smoke run has
+    19,683 roots): the ingest-phase watermark must drain to the host pool
+    without changing any count vs a roomy run."""
+    from raft_tla_tpu.models.smoke import smoke_init_states
+    sdims = RaftDims(n_servers=3, n_values=2, max_log=4, n_msg_slots=24)
+    roots = smoke_init_states(sdims, k=2, seed=7)   # ~512 random roots
+    assert len(roots) > 64
+    cons = build_constraint(
+        sdims, Bounds(max_term=2, max_log_len=1, max_msg_count=1))
+    want = BFSEngine(sdims, constraint=cons,
+                     config=small_config(max_diameter=1)).run(list(roots))
+    # queue 32 rows << root count: every ingest wave crosses the
+    # watermark and drains to the host pool before exploration starts.
+    got = BFSEngine(sdims, constraint=cons,
+                    config=small_config(batch=32, queue_capacity=32,
+                                        max_diameter=1,
+                                        record_trace=False)).run(list(roots))
+    assert got.distinct == want.distinct
+    assert got.levels == want.levels
+    assert got.generated == want.generated
+
+
 def test_seen_set_grows_in_place():
     """The FPSet must double (rehash) as load passes the threshold instead
     of dying; counts stay exact across growths."""
