@@ -49,9 +49,12 @@ def _tpu_tunnel_alive(timeout_s: float = 120.0) -> bool:
 
 
 def main():
-    # The sitecustomize hook registers the axon backend even when
-    # JAX_PLATFORMS is unset (utils/platform.py) — probe unless CPU was
-    # explicitly requested.
+    # An explicit JAX_PLATFORMS=cpu must actually take effect: the boot
+    # hook pins the axon backend by config, so the env var alone is
+    # ignored and `import jax` would still block on a dead tunnel.
+    from raft_tla_tpu.utils.platform import neutralize_axon_if_cpu_requested
+    neutralize_axon_if_cpu_requested()
+    # Otherwise probe the tunnel in a subprocess before touching it.
     if "cpu" not in os.environ.get("JAX_PLATFORMS", "") \
             and not _tpu_tunnel_alive():
         print("bench: TPU tunnel unresponsive; falling back to CPU",

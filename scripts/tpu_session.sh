@@ -17,10 +17,22 @@ mkdir -p artifacts
 NS_BUDGET="${1:-900}"
 
 probe() {
+    # RAFT_SESSION_ALLOW_CPU=1 lets the whole pipeline be smoke-tested
+    # without an accelerator (stages then run on the CPU fallback).
+    if [ "${RAFT_SESSION_ALLOW_CPU:-0}" = "1" ]; then
+        return 0
+    fi
     timeout 180 python -c \
         "import jax; assert jax.devices()[0].platform != 'cpu'" \
         2>/dev/null
 }
+
+# CLI stages need an explicit platform in CPU-smoke mode — the ambient
+# backend is the (possibly dead) tunnel regardless of JAX_PLATFORMS.
+PLAT_ARGS=""
+if [ "${RAFT_SESSION_ALLOW_CPU:-0}" = "1" ]; then
+    PLAT_ARGS="--platform cpu"
+fi
 
 echo "== 1. probe =="
 if ! probe; then
@@ -43,14 +55,14 @@ BENCH_SECONDS=60 timeout 900 python bench.py \
 echo "== 4. north-star attempt (budget ${NS_BUDGET}s, ckpt+spill) =="
 probe || { echo "tunnel died before north star; stopping"; exit 1; }
 timeout $((NS_BUDGET + 600)) python -m raft_tla_tpu check \
-    configs/TPUraft.cfg --max-seconds "${NS_BUDGET}" --no-trace \
+    configs/TPUraft.cfg ${PLAT_ARGS} --max-seconds "${NS_BUDGET}" --no-trace \
     --checkpoint-dir artifacts/ns_ckpt --spill-dir artifacts/ns_spill \
     2> artifacts/northstar_tpu.log | tee artifacts/northstar_tpu.txt
 
 echo "== 5. simulation at scale (300 s cap) =="
 probe || { echo "tunnel died before simulate; stopping"; exit 1; }
 timeout 600 python -m raft_tla_tpu simulate configs/MCraft_bounded.cfg \
-    --batch 8192 --num-steps 134217728 --max-seconds 300 \
+    ${PLAT_ARGS} --batch 8192 --num-steps 134217728 --max-seconds 300 \
     2> artifacts/simulate_tpu.log | tee artifacts/simulate_tpu.txt
 
 echo "== session complete; artifacts/ =="
