@@ -1,0 +1,92 @@
+"""SpillPool unit tests — both backends must be behaviorally identical.
+
+The engines exercise the pool indirectly (spill/checkpoint differential
+tests); these pin the container semantics directly, including the
+disk-mode corners the engines only hit at scale: FIFO order across
+pop/insert, empty-segment no-ops, concat_with's memmap assembly, and
+file cleanup on consume/clear/finalize.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from raft_tla_tpu.engine.spillpool import SpillPool
+
+
+def seg(lo, n, w=5):
+    return (np.arange(lo, lo + n)[:, None]
+            * np.ones((1, w))).astype(np.uint8)
+
+
+@pytest.mark.parametrize("disk", [False, True])
+def test_fifo_order_and_totals(tmp_path, disk):
+    pool = SpillPool(str(tmp_path / "p") if disk else None)
+    assert not pool and len(pool) == 0 and pool.total_rows() == 0
+    pool.append(seg(0, 3))
+    pool.append(seg(10, 4))
+    pool.append(seg(20, 2))
+    assert len(pool) == 3 and pool.total_rows() == 9
+    # segments() iterates without consuming
+    assert [len(s) for s in pool.segments()] == [3, 4, 2]
+    assert len(pool) == 3
+    a = pool.pop(0)
+    np.testing.assert_array_equal(np.asarray(a), seg(0, 3))
+    b = pool.pop(0)
+    assert np.asarray(b)[0, 0] == 10
+    assert pool.total_rows() == 2
+
+
+@pytest.mark.parametrize("disk", [False, True])
+def test_insert_front_and_empty_noops(tmp_path, disk):
+    pool = SpillPool(str(tmp_path / "p") if disk else None)
+    pool.append(seg(0, 3))
+    big = pool.pop(0)
+    pool.insert(0, np.asarray(big)[1:])        # put back the tail
+    pool.append(seg(50, 1))
+    # empty appends/inserts are no-ops in both modes
+    pool.append(seg(0, 0))
+    pool.insert(0, seg(0, 0))
+    assert [len(s) for s in pool.segments()] == [2, 1]
+    first = np.asarray(pool.pop(0))
+    assert first[0, 0] == 1                    # tail of the original
+
+
+@pytest.mark.parametrize("disk", [False, True])
+def test_concat_with_and_cleanup(tmp_path, disk):
+    d = tmp_path / "p"
+    pool = SpillPool(str(d) if disk else None)
+    head = seg(100, 2)
+    # no segments: head returned as-is
+    out, cleanup = pool.concat_with(head)
+    np.testing.assert_array_equal(np.asarray(out), head)
+    cleanup()
+    pool.append(seg(0, 3))
+    pool.append(seg(10, 1))
+    out, cleanup = pool.concat_with(head)
+    got = np.asarray(out).copy()
+    want = np.concatenate([head, seg(0, 3), seg(10, 1)])
+    np.testing.assert_array_equal(got, want)
+    cleanup()
+    # the pool still holds its segments after a checkpoint assembly
+    assert pool.total_rows() == 4
+    pool.clear()
+    assert not pool
+    if disk:
+        assert list(d.iterdir()) == []         # all files gone
+
+
+def test_disk_files_unlinked_on_pop_and_del(tmp_path):
+    d = tmp_path / "p"
+    pool = SpillPool(str(d))
+    pool.append(seg(0, 3))
+    pool.append(seg(10, 3))
+    arr = pool.pop(0)
+    # popped file is unlinked immediately; mapping stays readable
+    assert len(list(d.iterdir())) == 1
+    assert np.asarray(arr)[2, 0] == 2
+    del pool                                    # finalizer clears leftovers
+    import gc
+    gc.collect()
+    assert list(d.iterdir()) == []
