@@ -130,6 +130,7 @@ def main():
         "diameter": res.diameter,
         "levels": res.levels,
         "stop_reason": res.stop_reason,
+        "generated_by_action": res.action_counts,
         "baseline_states_per_sec": round(base_rate, 1),
         "baseline_distinct": ores.distinct_states,
         "baseline_wall_s": round(base_wall, 2),

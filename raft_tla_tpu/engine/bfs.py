@@ -119,6 +119,9 @@ class EngineResult:
     generated: int = 0
     diameter: int = 0
     levels: List[int] = dataclasses.field(default_factory=list)
+    # Enabled-successor count per action family (TLC's per-action
+    # statistics; family name -> count; sums to ``generated``).
+    action_counts: Dict[str, int] = dataclasses.field(default_factory=dict)
     violation: Optional[Violation] = None
     deadlock: Optional[PyState] = None
     stop_reason: str = "exhausted"
@@ -353,12 +356,13 @@ class BFSEngine:
                     jnp.bool_(False), jnp.zeros((sw,), jnp.uint8),
                     jnp.bool_(False), jnp.int32(-1),
                     jnp.zeros((sw,), jnp.uint8),
-                    jnp.uint32(0), jnp.uint32(0), jnp.bool_(False))
+                    jnp.uint32(0), jnp.uint32(0), jnp.bool_(False),
+                    jnp.zeros((len(dims.family_sizes),), _I32))
 
             def cond(c):
                 (offset, steps, _qn, next_count, seen_c, _tb, tcount,
                  _g, _n, ovfc, dead_any, _dr, viol_any, _vi, _vr, _vh,
-                 _vl, fail_any) = c
+                 _vl, fail_any, _fam) = c
                 more = (offset < cur_count) & (steps < max_steps)
                 qroom = next_count <= QTH       # host spills past this
                 # Stop for growth at half-full: the host doubles the table
@@ -378,11 +382,13 @@ class BFSEngine:
                 cond, lambda c: chunk_body(qcur, cur_count, c), init)
             (offset, steps, qnext, next_count, seen, tbuf, tcount,
              gen, newc, ovfc, dead_any, drow, viol_any, vinv, vrow,
-             vhi, vlo, fail_any) = out
-            stats = jnp.stack([
+             vhi, vlo, fail_any, fam_counts) = out
+            # fam_counts rides in the SAME packed vector — the loop's
+            # one-fetch-per-call contract is load-bearing over the tunnel.
+            stats = jnp.concatenate([jnp.stack([
                 offset, steps, next_count, seen.size, tcount, gen, newc,
                 ovfc, dead_any.astype(_I32), viol_any.astype(_I32), vinv,
-                fail_any.astype(_I32)])
+                fail_any.astype(_I32)]), fam_counts])
             return (qnext, seen, tbuf, stats, drow, vrow,
                     jnp.stack([vhi, vlo]))
 
@@ -551,6 +557,7 @@ class BFSEngine:
             res.generated = resume.generated
             res.diameter = resume.diameter
             res.levels = list(resume.levels)
+            res.action_counts = dict(resume.action_counts)
             # Duration (TLCGet("duration")-style) accumulates across
             # restarts: back-date t0 so wall_seconds, states/sec, and the
             # max_seconds budget all measure total checking time.
@@ -707,6 +714,10 @@ class BFSEngine:
                     vinv, fail = int(st[10]), bool(st[11])
                     res.distinct += n_new
                     res.generated += n_gen
+                    if n_gen:
+                        for name, c in zip(dims.family_names, st[12:]):
+                            res.action_counts[name] = (
+                                res.action_counts.get(name, 0) + int(c))
                     if cfg.record_trace and tcount:
                         self._flush_trace(trace, tbuf, tcount)
                     if n_ovf:
@@ -868,6 +879,7 @@ class BFSEngine:
             seen_hi=seen_hi, seen_lo=seen_lo,
             distinct=res.distinct, generated=res.generated,
             diameter=res.diameter, levels=tuple(res.levels),
+            action_counts=dict(res.action_counts),
             wall_seconds=wall,
             trace_fps=tf, trace_parents=tp, trace_actions=ta, roots=roots)
         try:

@@ -137,6 +137,11 @@ def format_result(res: EngineResult) -> str:
         f"wall seconds       {res.wall_seconds:.2f}",
         f"states/sec         {res.states_per_second:.0f}",
     ]
+    if res.action_counts:
+        lines.append("generated by action family:")
+        for name, c in sorted(res.action_counts.items(),
+                              key=lambda kv: -kv[1]):
+            lines.append(f"  {name:22s} {c}")
     if res.violation is not None:
         lines.append(f"VIOLATION          {res.violation.invariant} "
                      f"(fp {res.violation.fingerprint:#018x})")

@@ -49,6 +49,10 @@ class Checkpoint:
     generated: int
     diameter: int
     levels: Tuple[int, ...]
+    # Per-action-family generated counts (may be {} for snapshots written
+    # before the field existed; the engines then under-report pre-resume
+    # action stats but all other counters stay exact).
+    action_counts: Dict[str, int]
     wall_seconds: float          # cumulative checking time before the snapshot
     trace_fps: np.ndarray          # [T] uint64
     trace_parents: np.ndarray      # [T] uint64
@@ -65,6 +69,7 @@ def save(path: str, ckpt: Checkpoint) -> None:
         "generated": ckpt.generated,
         "diameter": ckpt.diameter,
         "levels": list(ckpt.levels),
+        "action_counts": dict(ckpt.action_counts),
         "wall_seconds": ckpt.wall_seconds,
     }
     os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
@@ -106,6 +111,7 @@ def load(path: str) -> Checkpoint:
             generated=meta["generated"],
             diameter=meta["diameter"],
             levels=tuple(meta["levels"]),
+            action_counts=dict(meta.get("action_counts", {})),
             wall_seconds=float(meta.get("wall_seconds", 0.0)),
             trace_fps=z["trace_fps"],
             trace_parents=z["trace_parents"],

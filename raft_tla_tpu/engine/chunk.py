@@ -17,10 +17,14 @@ bit-identical per batch (same candidate order, same compaction, same
 trace layout) for checkpoints to be portable across engines and for the
 differential tests to mean anything.
 
-The carry tuple layout (18 fields) is:
+The carry tuple layout (19 fields) is:
     (offset, steps, qnext, next_count, seen, tbuf, tcount,
      gen, newc, ovfc, dead_any, drow, viol_any, vinv, vrow, vhi, vlo,
-     fail_any)
+     fail_any, fam_counts)
+
+``fam_counts`` [n_families] accumulates enabled-successor counts per
+action family (TLC's per-action statistics; SURVEY §5.1) — a handful of
+static-slice reduces per batch.
 """
 
 from __future__ import annotations
@@ -46,10 +50,12 @@ def build_chunk_body(*, dims, expand, fingerprint, pack_ok, inv_fns,
     BG = B * G
     inv_id = build_inv_id(inv_fns) if inv_fns else None
 
+    fam_slices = tuple(zip(dims.family_offsets, dims.family_sizes))
+
     def chunk_body(qcur, cur_count, carry):
         (offset, steps, qnext, next_count, seen, tbuf, tcount,
          gen, newc, ovfc, dead_any, drow, viol_any, vinv, vrow,
-         vhi, vlo, fail_any) = carry
+         vhi, vlo, fail_any, fam_counts) = carry
         rows = jax.lax.dynamic_slice_in_dim(qcur, offset, B, axis=0)
         valid = (offset + jnp.arange(B, dtype=_I32)) < cur_count
         states = jax.vmap(unflatten_state, (0, None))(rows, dims)
@@ -124,12 +130,15 @@ def build_chunk_body(*, dims, expand, fingerprint, pack_ok, inv_fns,
         vhi = jnp.where(take_v, kh[vpos], vhi)
         vlo = jnp.where(take_v, kl[vpos], vlo)
         drow = jnp.where(dead_any | ~dead_any_b, drow, drow_b)
+        fam_counts = fam_counts + jnp.stack(
+            [jnp.sum(en[:, off:off + sz], dtype=_I32)
+             for off, sz in fam_slices])
         return (offset + P, steps + 1, qnext, next_count, seen, tbuf,
                 tcount, gen + total,
                 newc + jnp.sum(new, dtype=_I32),
                 ovfc + jnp.sum(ovf, dtype=_I32),
                 dead_any | dead_any_b, drow,
                 viol_any | viol_any_b, vinv, vrow, vhi, vlo,
-                fail_any | fail)
+                fail_any | fail, fam_counts)
 
     return chunk_body

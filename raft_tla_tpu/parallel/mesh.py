@@ -230,12 +230,13 @@ class MeshBFSEngine:
                     jnp.bool_(False), jnp.zeros((sw,), jnp.uint8),
                     jnp.bool_(False), jnp.int32(-1),
                     jnp.zeros((sw,), jnp.uint8),
-                    jnp.uint32(0), jnp.uint32(0), jnp.bool_(False))
+                    jnp.uint32(0), jnp.uint32(0), jnp.bool_(False),
+                    jnp.zeros((len(dims.family_sizes),), _I32))
 
             def cond(c):
                 (offset, steps, _qn, ncnt_c, seen_c, _tb, tcnt_c,
                  _g, _n, ovfc, dead_any, _dr, viol_any, _vi, _vr, _vh,
-                 _vl, fail_any) = c
+                 _vl, fail_any, _fam) = c
                 # Every term is reduced to a REPLICATED bool so all chips
                 # take the same trip count (the body contains all_to_all).
                 more = (offset < max_count) & (steps < max_steps)
@@ -253,12 +254,16 @@ class MeshBFSEngine:
                 cond, lambda c: chunk_body(qcur_l, cnt_l, c), init)
             (offset, steps, qnext_l, ncnt_l, seen_l, tbuf_l, tcnt_l,
              gen, newc, ovfc, dead_any, drow, viol_any, vinv, vrow,
-             vhi, vlo, fail_any) = out
+             vhi, vlo, fail_any, fam_counts) = out
             g_gen = jax.lax.psum(gen, "x")
             g_new = jax.lax.psum(newc, "x")
             g_ovf = jax.lax.psum(ovfc, "x")
             g_fail = jax.lax.psum(fail_any.astype(_I32), "x")
-            stats = jnp.stack([offset, steps, g_gen, g_new, g_ovf, g_fail])
+            # per-family counts ride in the same packed stats vector
+            # (one host fetch per call — engine/bfs.py contract).
+            stats = jnp.concatenate([
+                jnp.stack([offset, steps, g_gen, g_new, g_ovf, g_fail]),
+                jax.lax.psum(fam_counts, "x")])
             local = jnp.stack([ncnt_l, seen_l.size, tcnt_l,
                                dead_any.astype(_I32), viol_any.astype(_I32),
                                vinv])
@@ -485,6 +490,7 @@ class MeshBFSEngine:
             res.generated = resume.generated
             res.diameter = resume.diameter
             res.levels = list(resume.levels)
+            res.action_counts = dict(resume.action_counts)
             t0 -= resume.wall_seconds
             if cfg.record_trace:
                 if resume.distinct > 0 and resume.trace_fps.size == 0:
@@ -608,6 +614,10 @@ class MeshBFSEngine:
                     offset = int(st[0])
                     res.generated += int(st[2])
                     res.distinct += int(st[3])
+                    if int(st[2]):
+                        for name, c in zip(dims.family_names, st[6:]):
+                            res.action_counts[name] = (
+                                res.action_counts.get(name, 0) + int(c))
                     if int(st[4]):
                         raise RuntimeError(
                             f"{int(st[4])} successors exceeded fixed-width "
@@ -744,6 +754,7 @@ class MeshBFSEngine:
             seen_lo=keys_lo[order].astype(np.uint32),
             distinct=res.distinct, generated=res.generated,
             diameter=res.diameter, levels=tuple(res.levels),
+            action_counts=dict(res.action_counts),
             wall_seconds=wall,
             trace_fps=tf, trace_parents=tp, trace_actions=ta, roots=roots)
         try:

@@ -49,6 +49,10 @@ def test_counts_match_oracle_through_level3(engine):
     assert res.levels == want.levels
     assert res.stop_reason == "diameter_budget"
     assert res.generated == want.generated_states
+    # Per-action-family stats (TLC's per-action counts) partition the
+    # generated total.
+    assert sum(res.action_counts.values()) == res.generated
+    assert res.action_counts.get("Timeout", 0) > 0
 
 
 def test_violation_found_at_min_depth_and_replays():
