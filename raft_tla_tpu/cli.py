@@ -74,9 +74,14 @@ def main(argv=None):
     # Default sized for the BASELINE workload (1M traces x depth 100 ~=
     # 1e8 walker-steps) — minutes on a TPU chip; use --max-seconds or a
     # smaller --num-steps on CPU.
-    s.add_argument("--num-steps", type=int, default=1 << 27)
+    s.add_argument("--num-steps", type=int, default=1 << 27,
+                   help="total walker-steps; default %(default)s (~1e8) is "
+                        "sized for a TPU chip and takes hours on CPU — "
+                        "pass --max-seconds or a smaller value there")
     s.add_argument("--depth", type=int, default=100)
-    s.add_argument("--max-seconds", type=float, default=None)
+    s.add_argument("--max-seconds", type=float, default=None,
+                   help="wall-clock budget; stops cleanly before "
+                        "--num-steps is reached")
 
     args = p.parse_args(argv)
     platform = args.platform

@@ -283,15 +283,22 @@ class BFSEngine:
                 cons_ok = jnp.ones((k,), bool)
             enq = new & cons_ok
             pos = next_count + jnp.cumsum(enq.astype(_I32)) - 1
-            pos = jnp.where(enq, pos, Q)
+            # Disabled lanes scatter to distinct trash rows past Q (PAD =
+            # max(B, K) >= k guarantees room) — a single shared trash index
+            # would serialize the scatter on TPU (ops/fpset.py design note 3).
+            pos = jnp.where(enq, pos, Q + jnp.arange(k, dtype=_I32))
             qnext = qnext.at[pos].set(crows, mode="drop")
             next_count = next_count + jnp.sum(enq, dtype=_I32)
 
-            # Compacted trace records for the n_new fresh states.
-            tpos = jnp.where(new, jnp.cumsum(new.astype(_I32)) - 1, k)
+            # Compacted trace records for the n_new fresh states.  Non-new
+            # lanes spread over k..2k-1 trash slots (sliced off below) — a
+            # single shared drop index would serialize the five scatters
+            # (ops/fpset.py design note 3).
+            tpos = jnp.where(new, jnp.cumsum(new.astype(_I32)) - 1,
+                             k + jnp.arange(k, dtype=_I32))
 
             def compact(x):
-                return jnp.zeros((k,), x.dtype).at[tpos].set(x, mode="drop")
+                return jnp.zeros((2 * k,), x.dtype).at[tpos].set(x)[:k]
 
             tr = (compact(fph), compact(fpl),
                   compact(parent_hi), compact(parent_lo), compact(actions))

@@ -52,7 +52,13 @@ def build_compactor(B: int, G: int, K: int, reduce_p=None):
     - ``total``   number of live compacted lanes (== sum of en over the
                   first P parents);
     - ``lane_id`` [K] flat candidate-lane index per compacted slot
-                  (spread addresses in dead slots);
+                  (spread addresses in dead slots).  Disabled lanes write
+                  to the K-slot trash region ``K + (lane & (K-1))``; when
+                  B*G > K that aliases ~B*G/K lanes per trash slot (~G/16
+                  ≈ 8 at the default K = 16·B) — bounded write conflicts,
+                  accepted: spreading fully would need a K+B*G-wide
+                  scratch target, and an 8-way conflict is noise next to
+                  the all-lanes-one-address serialization this avoids;
     - ``kvalid``  [K] liveness mask (arange < total).
 
     ``reduce_p`` (optional) reduces the locally-computed P before it is

@@ -190,13 +190,17 @@ class MeshBFSEngine:
                 cons_ok = jnp.ones((k,), bool)
             enq = new & cons_ok
             pos = next_count + jnp.cumsum(enq.astype(_I32)) - 1
-            pos = jnp.where(enq, pos, QL)
+            # Per-lane trash rows past QL (PAD = max(B, K) >= k): a single
+            # shared trash index serializes the scatter on TPU (ops/fpset.py
+            # design note 3).
+            pos = jnp.where(enq, pos, QL + jnp.arange(k, dtype=_I32))
             qnext = qnext.at[pos].set(crows, mode="drop")
             next_count = next_count + jnp.sum(enq, dtype=_I32)
 
             if record_static:
                 tpos = jnp.where(
-                    new, tcount + jnp.cumsum(new.astype(_I32)) - 1, TQ)
+                    new, tcount + jnp.cumsum(new.astype(_I32)) - 1,
+                    TQ + jnp.arange(k, dtype=_I32))  # TA = TQ + K >= TQ + k
                 tbuf = tuple(
                     buf.at[tpos].set(col, mode="drop")
                     for buf, col in zip(

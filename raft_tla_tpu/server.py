@@ -33,6 +33,14 @@ Errors: {"ok": false, "error": "<message>"}.  Requests are served one at
 a time (a checking run owns the device); concurrent connections queue.
 
 Run:  python -m raft_tla_tpu.server [--port 8610] [--platform cpu]
+
+Trust model: the service is UNAUTHENTICATED and the "cfg" op accepts an
+arbitrary filesystem path, whose parse errors can echo file contents —
+so the default bind is loopback and the service trusts every client the
+bind address admits (same model as TLC's distributed-mode RMI endpoints).
+Binding a non-loopback --host hands that power to the network segment;
+do it only behind a firewall/ssh tunnel, or pass cfg_text instead of
+path-based cfg and run the process with a restricted filesystem view.
 """
 
 from __future__ import annotations

@@ -62,6 +62,8 @@ class ParsedCfg:
     constraints: List[str] = dataclasses.field(default_factory=list)
     action_constraints: List[str] = dataclasses.field(default_factory=list)
     properties: List[str] = dataclasses.field(default_factory=list)
+    symmetry: Optional[str] = None
+    view: Optional[str] = None
     check_deadlock: bool = True        # TLC default
     backend: Dict[str, object] = dataclasses.field(default_factory=dict)
 
@@ -168,6 +170,15 @@ def parse_cfg(text: str) -> ParsedCfg:
         elif mode in ("PROPERTY", "PROPERTIES"):
             cfg.properties.append(t)
             i += 1
+        elif mode in ("SYMMETRY", "VIEW"):
+            # Captured so load_config can reject them loudly (below); the
+            # reference cfgs use neither (MCraft.cfg:1-39 has "No SYMMETRY,
+            # no VIEW" per SURVEY §1 L5), so rejection — not implementation
+            # — is the required behavior: silently dropping either would
+            # report non-TLC state counts with no warning.
+            setattr(cfg, mode.lower(), t)
+            i += 1
+            mode = None
         else:
             i += 1
     return cfg
@@ -287,6 +298,18 @@ def load_config(cfg_path: str, max_log: Optional[int] = None,
         raise NotImplementedError(
             f"ACTION_CONSTRAINT {cfg.action_constraints} not supported: "
             "action constraints range over transitions, not states")
+
+    if cfg.symmetry is not None:
+        raise NotImplementedError(
+            f"SYMMETRY {cfg.symmetry} not supported: symmetry reduction "
+            "quotients the state space and changes distinct-state counts; "
+            "running without it would silently disagree with TLC")
+
+    if cfg.view is not None:
+        raise NotImplementedError(
+            f"VIEW {cfg.view} not supported: a view changes which states "
+            "are considered distinct; fingerprints here cover the full "
+            "canonical state only")
 
     if cfg.properties:
         # Temporal properties (PROPERTY/PROPERTIES) need liveness checking

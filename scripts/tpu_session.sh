@@ -12,6 +12,7 @@
 #                        budgeted; level profile recorded
 #   5. simulation      - BASELINE configs[3] scale (capped by time budget)
 set -u
+set -o pipefail   # a crashed stage must not be masked by tee
 cd "$(dirname "$0")/.."
 mkdir -p artifacts
 NS_BUDGET="${1:-900}"

@@ -1,0 +1,47 @@
+#!/bin/bash
+# Round-4 TPU watchdog: probe the tunnel until it answers, then run the
+# full measurement session. Every probe is timestamped to the log — if
+# the tunnel stays dead all round, the log IS the hardware-evidence
+# artifact (VERDICT r3, next-round item 1).
+#
+# RAFT_SESSION_ALLOW_CPU=1 smoke-tests the whole pipeline without an
+# accelerator (the probe and the session both honor it). A failing
+# session is retried at most MAX_SESSION_FAILS times — a deterministic
+# stage bug must not relaunch the multi-stage session forever.
+set -u
+cd "$(dirname "$0")/.."
+mkdir -p artifacts
+LOG=artifacts/tpu_watchdog_r04.log
+NS_BUDGET="${1:-900}"
+MAX_SESSION_FAILS="${MAX_SESSION_FAILS:-3}"
+fails=0
+echo "$(date -u +%FT%TZ) watchdog start (pid $$)" >> "$LOG"
+probe() {
+    [ "${RAFT_SESSION_ALLOW_CPU:-0}" = "1" ] && return 0
+    timeout 180 python -c \
+        "import jax; assert jax.devices()[0].platform != 'cpu'" 2>/dev/null
+}
+while true; do
+    if probe; then
+        echo "$(date -u +%FT%TZ) probe OK - launching tpu_session" >> "$LOG"
+        bash scripts/tpu_session.sh "$NS_BUDGET" >> artifacts/tpu_session_r04.out 2>&1
+        rc=$?
+        echo "$(date -u +%FT%TZ) tpu_session exit rc=$rc" >> "$LOG"
+        [ $rc -eq 0 ] && exit 0
+        # Count the failure only if the tunnel is still alive (a stage bug,
+        # not a mid-session tunnel drop — drops are what we wait out).
+        if probe; then
+            fails=$((fails + 1))
+            if [ $fails -ge "$MAX_SESSION_FAILS" ]; then
+                echo "$(date -u +%FT%TZ) giving up: $fails failures with tunnel alive" >> "$LOG"
+                exit 1
+            fi
+        else
+            echo "$(date -u +%FT%TZ) session died with tunnel (uncounted)" >> "$LOG"
+        fi
+        sleep 120
+    else
+        echo "$(date -u +%FT%TZ) probe FAIL (timeout-or-cpu)" >> "$LOG"
+        sleep 180
+    fi
+done

@@ -123,3 +123,22 @@ def test_property_rejected_loudly(tmp_path):
         "SPECIFICATION Spec\nPROPERTY EventuallyLeader\n")
     with pytest.raises(NotImplementedError, match="EventuallyLeader"):
         load_config(str(cfgf))
+
+
+def test_symmetry_rejected_loudly(tmp_path):
+    """SYMMETRY quotients the state space — running without it would report
+    non-TLC distinct-state counts with no warning (MCraft.cfg deliberately
+    has none; SURVEY §1 L5), so the statement must fail the load by name."""
+    cfgf = tmp_path / "sym.cfg"
+    cfgf.write_text("CONSTANT Server = {r1}\nCONSTANT Value = {v1}\n"
+                    "SYMMETRY Perms\n")
+    with pytest.raises(NotImplementedError, match="SYMMETRY Perms"):
+        load_config(str(cfgf))
+
+
+def test_view_rejected_loudly(tmp_path):
+    cfgf = tmp_path / "view.cfg"
+    cfgf.write_text("CONSTANT Server = {r1}\nCONSTANT Value = {v1}\n"
+                    "VIEW NoTermView\n")
+    with pytest.raises(NotImplementedError, match="VIEW NoTermView"):
+        load_config(str(cfgf))

@@ -197,3 +197,27 @@ def test_mesh_order_independence():
     assert got.distinct == want.distinct
     assert got.levels == want.levels
     assert got.generated == want.generated
+
+
+def test_dryrun_ground_truth_pinned():
+    """The driver's dryrun_multichip model (__graft_entry__.py) asserts
+    46,553 distinct / diameter 31 — re-derive that constant here from BOTH
+    the independent Python oracle and the mesh engine, so kernel or oracle
+    drift fails the suite before it fails a driver-side dryrun (SURVEY §4
+    differential contract)."""
+    dims = RaftDims(n_servers=2, n_values=1, max_log=2, n_msg_slots=8)
+    bounds = Bounds(max_term=2, max_log_len=1, max_msg_count=1,
+                    max_in_flight=2)
+    want = orc.bfs([init_state(dims)], dims,
+                   constraint=constraint_py(bounds), check_deadlock=False)
+    assert want.distinct_states == 46553
+    assert len(want.levels) - 1 == 31    # diameter
+    eng = MeshBFSEngine(
+        dims, constraint=build_constraint(dims, bounds),
+        config=EngineConfig(batch=256, queue_capacity=1 << 12,
+                            seen_capacity=1 << 16, check_deadlock=False,
+                            record_trace=False, sync_every=8))
+    res = eng.run([init_state(dims)])
+    assert res.stop_reason == "exhausted"
+    assert res.distinct == 46553 and res.diameter == 31
+    assert res.generated == want.generated_states
