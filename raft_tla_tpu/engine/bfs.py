@@ -93,6 +93,12 @@ class EngineConfig:
     sync_every: int = 32         # device batches per host round-trip
     max_seconds: Optional[float] = None   # StopAfter duration budget
     max_diameter: Optional[int] = None    # StopAfter diameter budget
+    # Further TLCGet-consulting budgets as (counter, threshold) pairs over
+    # "distinct" / "generated" / "queue" (utils/cfg.py EXIT_COUNTERS) —
+    # the general metrics-control coupling (SURVEY §5.5): checked against
+    # live counters after every chunk stats fetch, stop_reason
+    # "<counter>_budget".  duration/diameter ride the two fields above.
+    exit_conditions: tuple = ()
     checkpoint_dir: Optional[str] = None  # R8: level-boundary snapshots
     checkpoint_every: int = 1             # snapshot every k levels...
     checkpoint_interval_seconds: float = 0.0  # ...but at most this often.
@@ -136,6 +142,18 @@ class EngineResult:
 # re-exported here for compatibility.
 from .trace import PyTraceStore as TraceStore  # noqa: E402
 from .trace import make_trace_store  # noqa: E402
+
+
+def _exit_condition_hit(conds, res, queue_rows):
+    """First tripped TLCGet budget, as its stop_reason — or None.
+    ``conds`` holds only the counters without native budget fields
+    (utils/cfg.py routes duration/diameter to max_seconds/max_diameter)."""
+    live = {"distinct": res.distinct, "generated": res.generated,
+            "queue": queue_rows}
+    for counter, threshold in conds:
+        if live[counter] > threshold:
+            return f"{counter}_budget"
+    return None
 
 
 def build_root_check(inv_fns, fingerprint):
@@ -598,6 +616,13 @@ class BFSEngine:
                         and time.time() - t0 > cfg.max_seconds:
                     res.stop_reason = "duration_budget"
                     break
+                if base and cfg.exit_conditions:
+                    hit = _exit_condition_hit(
+                        cfg.exit_conditions, res,
+                        int(next_count) + spill_next.total_rows())
+                    if hit:
+                        res.stop_reason = hit
+                        break
                 chunk = rows_np[base:base + B]
                 pad = np.zeros((B - len(chunk), sw), ROW_DTYPE)
                 valid = np.arange(B) < len(chunk)
@@ -767,6 +792,16 @@ class BFSEngine:
                             unflatten_state(np.asarray(out[4]), dims), dims)
                         res.stop_reason = "deadlock"
                         break
+                    if cfg.exit_conditions:
+                        # Checked last: a violation or deadlock in the same
+                        # chunk outranks a budget stop (TLC reports the
+                        # error, not the exit).
+                        hit = _exit_condition_hit(
+                            cfg.exit_conditions, res,
+                            next_count_h + spill_next.total_rows())
+                        if hit:
+                            res.stop_reason = hit
+                            break
                 if res.stop_reason != "exhausted" \
                         or res.violation is not None or not pending:
                     break

@@ -103,7 +103,8 @@ def make_engine(setup: CheckSetup,
         max_seconds=(base.max_seconds if base.max_seconds is not None
                      else setup.max_seconds),
         max_diameter=(base.max_diameter if base.max_diameter is not None
-                      else setup.max_diameter))
+                      else setup.max_diameter),
+        exit_conditions=(base.exit_conditions or setup.exit_conditions))
     cls = engine_cls or BFSEngine
     return cls(setup.dims, invariants=resolve_invariants(setup),
                constraint=resolve_constraint(setup), config=cfg)

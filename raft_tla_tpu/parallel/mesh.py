@@ -53,8 +53,8 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..engine.chunk import build_chunk_body
 from ..engine.bfs import (EngineConfig, EngineResult, TraceStore, Violation,
-                          build_root_check, find_root_violation,
-                          make_trace_store)
+                          _exit_condition_hit, build_root_check,
+                          find_root_violation, make_trace_store)
 from ..models.actions import build_expand
 from ..models.dims import RaftDims
 from ..models.invariants import build_inv_id
@@ -523,6 +523,14 @@ class MeshBFSEngine:
                         and time.time() - t0 > cfg.max_seconds:
                     res.stop_reason = "duration_budget"
                     break
+                if c and cfg.exit_conditions:
+                    hit = _exit_condition_hit(
+                        cfg.exit_conditions, res,
+                        int(np.asarray(next_counts).sum())
+                        + spill_next.total_rows())
+                    if hit:
+                        res.stop_reason = hit
+                        break
                 wave = np.zeros((n, B, sw), ROW_DTYPE)
                 valid = np.zeros((n, B), bool)
                 for d in range(n):
@@ -664,6 +672,16 @@ class MeshBFSEngine:
                             np.asarray(drow)[d], dims), dims)
                         res.stop_reason = "deadlock"
                         break
+                    if cfg.exit_conditions:
+                        # Last: a violation/deadlock in the same chunk
+                        # outranks a budget stop (engine/bfs.py rationale).
+                        hit = _exit_condition_hit(
+                            cfg.exit_conditions, res,
+                            int(np.asarray(next_counts).sum())
+                            + spill_next.total_rows())
+                        if hit:
+                            res.stop_reason = hit
+                            break
                 if res.stop_reason != "exhausted" \
                         or res.violation is not None or not pending:
                     break

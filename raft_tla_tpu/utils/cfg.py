@@ -203,12 +203,57 @@ def scan_module_definitions(text: str) -> Dict[str, object]:
     return out
 
 
-def scan_stop_after(text: str) -> Tuple[Optional[float], Optional[int]]:
-    """StopAfter budgets from TLCGet patterns (Smokeraft.tla:88-92)."""
-    dur = re.search(r'TLCGet\("duration"\)\s*>\s*(\d+)', text)
-    dia = re.search(r'TLCGet\("diameter"\)\s*>\s*(\d+)', text)
-    return (float(dur.group(1)) if dur else None,
-            int(dia.group(1)) if dia else None)
+# Engine counters a TLCGet-consulting constraint may read — the live values
+# TLC exposes through its control channel (SURVEY §5.5).  duration/diameter
+# map onto the engines' native budget machinery; the rest are checked
+# against live result counters after every chunk of work.
+EXIT_COUNTERS = ("duration", "diameter", "distinct", "generated", "queue")
+
+_TLCSET_EXIT = r'TLCSet\(\s*"exit"\s*,\s*TLCGet\("(\w+)"\)\s*>\s*(\d+)\s*\)'
+
+
+@dataclasses.dataclass(frozen=True)
+class ExitOp:
+    """One operator of the StopAfter shape found in a companion module."""
+    conds: Tuple[Tuple[str, float], ...]
+    # True iff the body is NOTHING but TLCSet exit conjuncts — only then may
+    # the operator be consumed as a pure budget; a mixed budget+predicate
+    # CONSTRAINT is rejected at load (dropping the predicate half would
+    # silently change state counts).
+    pure: bool
+
+
+def scan_exit_operators(text: str) -> Dict[str, ExitOp]:
+    """Find operators of the Smokeraft StopAfter shape (Smokeraft.tla:88-92)
+
+        Name ==
+            /\\ TLCSet("exit", TLCGet("<counter>") > <n>)
+            ...
+
+    and return {operator name: ExitOp}.  This is the general TLCGet/TLCSet
+    metrics-control coupling: any such PURE operator named as CONSTRAINT in
+    a cfg becomes a budget consulting live engine counters — no code changes
+    needed for e.g. ``TLCGet("distinct") > 1000000``.  Validation (unknown
+    counters, impure bodies) happens in load_config, and only for operators
+    a cfg actually names — an unused helper must not poison the module."""
+    out: Dict[str, ExitOp] = {}
+    clean = re.sub(r"\(\*.*?\*\)", "", text, flags=re.S)   # (* block *)
+    clean = re.sub(r"\\\*[^\n]*", "", clean)               # \* line
+    defs = list(re.finditer(r"^\s*(\w+)\s*(\([^)]*\))?\s*==", clean,
+                            flags=re.M))
+    for k, m in enumerate(defs):
+        end = defs[k + 1].start() if k + 1 < len(defs) else len(clean)
+        body = clean[m.end():end]
+        conds = re.findall(_TLCSET_EXIT, body)
+        if not conds:
+            continue
+        # Residue after removing the exit conjuncts: only /\ , \/ glue and
+        # the module terminator's ='s may remain for the body to be pure.
+        residue = re.sub(_TLCSET_EXIT, "", body)
+        pure = re.fullmatch(r"[\s/\\=-]*", residue) is not None
+        out[m.group(1)] = ExitOp(
+            conds=tuple((c, float(n)) for c, n in conds), pure=pure)
+    return out
 
 
 # ---------------------------------------------------------------------------
@@ -227,6 +272,9 @@ class CheckSetup:
     smoke_k: int = 2
     max_seconds: Optional[float] = None
     max_diameter: Optional[int] = None
+    # Further TLCGet-consulting budgets (counter, threshold) beyond the two
+    # with native engine machinery: distinct / generated / queue.
+    exit_conditions: Tuple[Tuple[str, float], ...] = ()
     server_names: Tuple[str, ...] = ()
     value_names: Tuple[str, ...] = ()
     cfg: Optional[ParsedCfg] = None
@@ -245,7 +293,7 @@ def load_config(cfg_path: str, max_log: Optional[int] = None,
     if n_msg_slots is None:
         n_msg_slots = cfg.backend.get("N_MSG_SLOTS", 32)
     moddefs: Dict[str, object] = {}
-    stop_dur = stop_dia = None
+    exit_ops: Dict[str, ExitOp] = {}
     # Scan the companion module and its EXTENDS chain (Smokeraft EXTENDS
     # MCraft — Smokeraft.tla:2 — whose const_* definitions the cfg names).
     mod_dir = os.path.dirname(os.path.abspath(cfg_path))
@@ -262,9 +310,8 @@ def load_config(cfg_path: str, max_log: Optional[int] = None,
         with open(cand) as f:
             text = f.read()
         moddefs.update(scan_module_definitions(text))
-        d, di = scan_stop_after(text)
-        stop_dur = stop_dur if d is None else d
-        stop_dia = stop_dia if di is None else di
+        for name, conds in scan_exit_operators(text).items():
+            exit_ops.setdefault(name, conds)
         ext = re.search(r"^\s*EXTENDS\s+([^\n]+)", text, flags=re.M)
         if ext:
             pending.extend(x.strip() for x in ext.group(1).split(","))
@@ -335,9 +382,31 @@ def load_config(cfg_path: str, max_log: Optional[int] = None,
         else:
             max_log = 8
 
+    # Any CONSTRAINT whose companion-module definition is a TLCSet("exit",
+    # TLCGet(...) > n) conjunction is a budget, not a state predicate —
+    # Smokeraft's StopAfter is simply the reference instance of the shape.
     max_seconds = max_diameter = None
-    if "StopAfter" in cfg.constraints:
-        max_seconds, max_diameter = stop_dur, stop_dia
+    exit_conditions: List[Tuple[str, float]] = []
+    budget_names = [c for c in cfg.constraints if c in exit_ops]
+    for name in budget_names:
+        op = exit_ops[name]
+        if not op.pure:
+            raise NotImplementedError(
+                f"CONSTRAINT {name} mixes TLCSet exit budgets with other "
+                "conjuncts; dropping the non-budget half would silently "
+                "change state counts — split the operator into a pure "
+                "budget and a pure state predicate")
+        for counter, threshold in op.conds:
+            if counter not in EXIT_COUNTERS:
+                raise NotImplementedError(
+                    f'TLCGet("{counter}") in CONSTRAINT {name} not '
+                    f"supported; available engine counters: {EXIT_COUNTERS}")
+            if counter == "duration":
+                max_seconds = threshold
+            elif counter == "diameter":
+                max_diameter = int(threshold)
+            else:
+                exit_conditions.append((counter, threshold))
 
     # TargetConfigs (a set of membership bitmasks over the interned server
     # order) selects the joint-consensus reconfiguration variant
@@ -359,9 +428,10 @@ def load_config(cfg_path: str, max_log: Optional[int] = None,
         dims=dims,
         bounds=bounds,
         invariants=list(cfg.invariants),
-        constraints=[c for c in cfg.constraints if c != "StopAfter"],
+        constraints=[c for c in cfg.constraints if c not in budget_names],
         check_deadlock=cfg.check_deadlock,
         smoke=smoke, smoke_k=smoke_k,
         max_seconds=max_seconds, max_diameter=max_diameter,
+        exit_conditions=tuple(exit_conditions),
         server_names=servers, value_names=values, cfg=cfg,
         backend=dict(cfg.backend))

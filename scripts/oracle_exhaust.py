@@ -45,6 +45,7 @@ def canon_digest(s) -> bytes:
 def main():
     cfg_path = sys.argv[1] if len(sys.argv) > 1 else "configs/MCraft_bounded.cfg"
     out_path = sys.argv[2] if len(sys.argv) > 2 else "oracle_exhaust.jsonl"
+    max_levels = int(sys.argv[3]) if len(sys.argv) > 3 else None
     setup = load_config(cfg_path)
     dims, bounds = setup.dims, setup.bounds
     constraint = constraint_py(bounds)
@@ -77,7 +78,8 @@ def main():
         out.flush()
 
     emit()
-    while frontier and inv_violation is None:
+    while frontier and inv_violation is None and (
+            max_levels is None or level < max_levels):
         nxt = []
         for s in frontier:
             succ = orc.successors(s, dims)
@@ -97,7 +99,8 @@ def main():
         frontier = nxt
         emit()
     emit(done=True,
-         reason="violation" if inv_violation else "exhausted")
+         reason="violation" if inv_violation else
+         ("level_budget" if frontier else "exhausted"))
     print(json.dumps({"cfg": cfg_path, "distinct": distinct,
                       "generated": generated, "diameter": level,
                       "levels": levels,

@@ -13,6 +13,7 @@ scalar fetch as the barrier) or end-to-end engine runs.
 """
 
 import sys
+import os
 import time
 
 sys.path.insert(0, ".")
@@ -28,8 +29,8 @@ import numpy as np
 from raft_tla_tpu.ops import fpset
 from raft_tla_tpu.ops.fingerprint import SENTINEL
 
-C = 1 << 23
-K = 1 << 18
+C = int(os.environ.get("FPSET_C", 1 << 23))   # table capacity
+K = int(os.environ.get("FPSET_K", 1 << 18))   # keys per insert
 
 
 def timeit(name, fn, *args, n=5):

@@ -53,6 +53,11 @@ probe || { echo "tunnel died before bench; stopping"; exit 1; }
 BENCH_SECONDS=60 timeout 900 python bench.py \
     2> artifacts/bench_tpu.log | tee artifacts/bench_tpu.json
 
+echo "== 3b. leader-rich bench (60 s) =="
+probe || { echo "tunnel died before leader bench; stopping"; exit 1; }
+timeout 900 python scripts/leader_bench.py 60 \
+    2> artifacts/leader_bench_tpu.log | tee artifacts/leader_bench_tpu.json
+
 echo "== 4. north-star attempt (budget ${NS_BUDGET}s, ckpt+spill) =="
 probe || { echo "tunnel died before north star; stopping"; exit 1; }
 timeout $((NS_BUDGET + 600)) python -m raft_tla_tpu check \

@@ -7,6 +7,7 @@ scalar — a true barrier.  Reported per-iteration time subtracts nothing;
 with N=8 the dispatch+RTT overhead is amortized to noise.
 """
 
+import os
 import sys
 import time
 
@@ -44,7 +45,8 @@ def main():
     print("platform:", jax.devices()[0].platform, " N =", N)
     setup = load_config("configs/MCraft_bounded.cfg")
     dims = setup.dims
-    B, G = 2048, dims.n_instances
+    B = int(os.environ.get("TB_BATCH", 2048))
+    G = dims.n_instances
     K = B * G
     # Workload generated in-process (runs from a fresh clone): a few real
     # BFS levels supply a representative mid-level frontier, and one

@@ -85,3 +85,219 @@ def test_smoke_init_product_structure():
     bags = {s.messages for s in states}
     assert len(bags) == 1               # one shared bag, multiplicity 1
     assert all(c == 1 for _m, c in next(iter(bags)))
+
+
+# ---------------------------------------------------------------------------
+# Golden successor vectors — hand-derived from the raft.tla TEXT, not from
+# either implementation.  The differential tests above compare two
+# transcriptions by the same author; a shared misreading would pass them all.
+# These vectors pin the nastiest branch semantics directly: each constructs a
+# state + one in-flight message, writes down the exact successor(s) the cited
+# spec lines require, and asserts BOTH the kernel and the oracle produce
+# exactly that (for the Receive family, at most one successor per message —
+# the disjuncts are pairwise mutually exclusive, SURVEY §3.3).
+
+from raft_tla_tpu.models.dims import (A_RECEIVE, AEQ, AER, RVQ, RVR,
+                                      CANDIDATE, FOLLOWER, LEADER, NIL)
+
+
+def receive_successors_both(expand, s):
+    """(kernel, oracle) successor lists restricted to the Receive family."""
+    st = encode_state(s, DIMS)
+    cands, enabled, overflow = jax.device_get(expand(st))
+    assert not overflow.any()
+    kout = []
+    for g in range(DIMS.n_instances):
+        if enabled[g] and DIMS.instance_info(g)[0] == A_RECEIVE:
+            row = jax.tree.map(lambda a: a[g], cands)
+            kout.append(decode_state(StateBatch(*row), DIMS))
+    oout = [t for (fam, _p), t in orc.successors(s, DIMS) if fam == A_RECEIVE]
+    return kout, oout
+
+
+def assert_golden(expand, s, expected):
+    """Both implementations must yield exactly ``expected`` (a list of
+    PyStates) for Receive over s's single in-flight message."""
+    kout, oout = receive_successors_both(expand, s)
+    assert oout == list(expected), f"oracle disagrees with spec text\n{s}"
+    assert kout == list(expected), f"kernel disagrees with spec text\n{s}"
+
+
+def bag(*msgs):
+    return frozenset((m, 1) for m in msgs)
+
+
+def test_golden_alreadydone_hidden_guard_blocks(expand):
+    """raft.tla:301-317: AppendEntriesAlreadyDone sets commitIndex' to
+    m.mcommitIndex (:309) AND asserts UNCHANGED logVars (:317), and logVars
+    includes commitIndex (:51) — so with mcommitIndex /= commitIndex[i] the
+    conjunction is unsatisfiable and Receive(m) has NO successor: every
+    sibling branch is also disabled (Reject needs stale term or ~logOk :282-285,
+    ReturnToFollower needs Candidate :297, Conflict/NoConflict need nonempty
+    entries :320/:328, UpdateTerm needs mterm > currentTerm :374)."""
+    aeq = (AEQ, 1, 0, 2, 1, 2, (), 1)   # mprev=1, mprevterm=2, entries=(), mcommit=1
+    s = init_state(DIMS).replace(
+        current_term=(2, 2, 2), log=(((2, 1),), (), ()),
+        messages=bag(aeq))
+    assert_golden(expand, s, [])
+
+
+def test_golden_alreadydone_fires_on_equal_commit(expand):
+    """Same state but mcommitIndex = commitIndex[i] = 0: the :309/:317
+    contradiction vanishes and AlreadyDone replies success with
+    mmatchIndex = mprevLogIndex + Len(mentries) = 1 + 0 (:313); Reply
+    consumes the request and adds the response atomically (:102-103);
+    serverVars and logVars unchanged (:317)."""
+    aeq = (AEQ, 1, 0, 2, 1, 2, (), 0)
+    s = init_state(DIMS).replace(
+        current_term=(2, 2, 2), log=(((2, 1),), (), ()),
+        messages=bag(aeq))
+    aer = (AER, 0, 1, 2, 1, 1)          # success=TRUE, mmatchIndex=1
+    assert_golden(expand, s, [s.replace(messages=bag(aer))])
+
+
+def test_golden_alreadydone_entry_already_present(expand):
+    """raft.tla:302-305: nonempty entries with Len(log[i]) >= index and
+    log[i][index].term = m.mentries[1].term is the 'already done' case —
+    the entry is NOT appended again; reply mmatchIndex = 0 + 1 (:313)."""
+    aeq = (AEQ, 1, 0, 2, 0, 0, ((2, 1),), 0)   # mprev=0, entries=<<[term 2]>>
+    s = init_state(DIMS).replace(
+        current_term=(2, 2, 2), log=(((2, 1),), (), ()),
+        messages=bag(aeq))
+    aer = (AER, 0, 1, 2, 1, 1)
+    assert_golden(expand, s, [s.replace(messages=bag(aer))])
+
+
+def test_golden_conflict_truncates_exactly_one_entry(expand):
+    """raft.tla:319-325: on a term conflict at index, the new log is
+    [index2 \\in 1..(Len(log[i]) - 1) |-> log[i][index2]] (:323-324) —
+    exactly ONE trailing entry is removed, regardless of where the conflict
+    index sits, and the message is NOT consumed (messages unchanged :325),
+    so the same request re-fires against the shorter log."""
+    aeq = (AEQ, 1, 0, 2, 1, 1, ((2, 1),), 0)   # conflict at index 2
+    s = init_state(DIMS).replace(
+        current_term=(2, 2, 2), log=(((1, 1), (1, 2)), (), ()),
+        messages=bag(aeq))
+    assert_golden(expand, s, [s.replace(log=(((1, 1),), (), ()))])
+
+
+def test_golden_noconflict_appends_without_consuming(expand):
+    """raft.tla:327-331: Len(log[i]) = mprevLogIndex appends mentries[1];
+    messages UNCHANGED (:331) — the accept branches reply only via
+    AlreadyDone, so the request stays in flight after the append."""
+    aeq = (AEQ, 1, 0, 2, 0, 0, ((2, 1),), 0)
+    s = init_state(DIMS).replace(
+        current_term=(2, 2, 2), messages=bag(aeq))
+    assert_golden(expand, s, [s.replace(log=(((2, 1),), (), ()))])
+
+
+def test_golden_updateterm_is_exclusive_and_keeps_message(expand):
+    """raft.tla:373-379 + :393: for a REQUEST with mterm > currentTerm[i],
+    only UpdateTerm is enabled (HandleAppendEntriesRequest requires
+    mterm <= currentTerm :352): adopt the term, become Follower, reset
+    votedFor (:375-377), and leave the message in flight (:378) to be
+    re-processed in a later state."""
+    aeq = (AEQ, 1, 0, 3, 0, 0, (), 0)
+    s = init_state(DIMS).replace(
+        role=(CANDIDATE, FOLLOWER, FOLLOWER), voted_for=(1, 0, 0),
+        messages=bag(aeq))
+    want = s.replace(current_term=(3, 1, 1),
+                     role=(FOLLOWER, FOLLOWER, FOLLOWER),
+                     voted_for=(NIL, 0, 0))
+    assert_golden(expand, s, [want])
+
+
+def test_golden_updateterm_on_response(expand):
+    """Responses with mterm > currentTerm[i] also take only UpdateTerm
+    (:393; HandleAppendEntriesResponse requires = :361, DropStaleResponse
+    requires < :383) — the response survives the term adoption."""
+    aer = (AER, 1, 0, 3, 1, 1)
+    s = init_state(DIMS).replace(messages=bag(aer))
+    assert_golden(expand, s, [s.replace(current_term=(3, 1, 1),
+                                        voted_for=(NIL, 0, 0))])
+
+
+def test_golden_stale_request_still_answered(expand):
+    """Guard asymmetry, request side (raft.tla:251): HandleRequestVoteRequest
+    accepts mterm <= currentTerm[i], so a STALE request is processed — the
+    grant conjunct requires equal terms (:248) so it is refused, and the
+    reply carries the receiver's own currentTerm (:255) and full log as
+    mlog (:259)."""
+    rvq = (RVQ, 1, 0, 2, 0, 0)          # mlastLogTerm=0, mlastLogIndex=0
+    s = init_state(DIMS).replace(current_term=(3, 3, 3), messages=bag(rvq))
+    rvr = (RVR, 0, 1, 3, 0, ())         # granted=FALSE, mlog=<<>>
+    assert_golden(expand, s, [s.replace(messages=bag(rvr))])
+
+
+def test_golden_stale_response_dropped_silently(expand):
+    """Guard asymmetry, response side (raft.tla:382-385 vs :361): a response
+    with mterm < currentTerm[i] matches only DropStaleResponse — discarded
+    with every other variable unchanged (no reply, no cursor update)."""
+    aer = (AER, 1, 0, 2, 1, 1)
+    s = init_state(DIMS).replace(
+        current_term=(3, 3, 3), role=(LEADER, FOLLOWER, FOLLOWER),
+        messages=bag(aer))
+    assert_golden(expand, s, [s.replace(messages=frozenset())])
+
+
+def test_golden_vote_granted_sets_votedfor(expand):
+    """raft.tla:244-262: equal term + logOk + votedFor in {Nil, j} grants:
+    votedFor' = j (:252) and the reply carries mvoteGranted = TRUE and
+    mlog = log[i] (:256-259); Reply consumes the request (:102-103)."""
+    rvq = (RVQ, 1, 0, 2, 0, 0)
+    s = init_state(DIMS).replace(current_term=(2, 2, 2), messages=bag(rvq))
+    rvr = (RVR, 0, 1, 2, 1, ())
+    assert_golden(expand, s,
+                  [s.replace(voted_for=(2, 0, 0), messages=bag(rvr))])
+
+
+def test_golden_vote_refused_when_already_voted(expand):
+    """raft.tla:250: votedFor[i] already names another server -> grant is
+    FALSE; votedFor is UNCHANGED (:253) and the refusal is still sent."""
+    rvq = (RVQ, 1, 0, 2, 0, 0)
+    s = init_state(DIMS).replace(current_term=(2, 2, 2),
+                                 voted_for=(1, 0, 0),   # voted for r1 (self)
+                                 messages=bag(rvq))
+    rvr = (RVR, 0, 1, 2, 0, ())
+    assert_golden(expand, s, [s.replace(messages=bag(rvr))])
+
+
+def test_golden_candidate_returns_to_follower_keeping_message(expand):
+    """raft.tla:295-299: an AE request at the candidate's own term -> step
+    down to Follower with messages UNCHANGED (:299); Reject is disabled
+    (needs stale term or Follower+~logOk :282-285) and Accept is disabled
+    (needs Follower :336), so stepping down is the only successor."""
+    aeq = (AEQ, 1, 0, 2, 0, 0, (), 0)
+    s = init_state(DIMS).replace(
+        current_term=(2, 2, 2), role=(CANDIDATE, FOLLOWER, FOLLOWER),
+        voted_for=(1, 0, 0), messages=bag(aeq))
+    assert_golden(expand, s,
+                  [s.replace(role=(FOLLOWER, FOLLOWER, FOLLOWER))])
+
+
+def test_golden_ae_response_updates_cursors(expand):
+    """raft.tla:360-370: success -> nextIndex'[i][j] = mmatchIndex + 1 and
+    matchIndex'[i][j] = mmatchIndex (:363-365); failure -> nextIndex
+    decrements but never below 1, Max({nextIndex - 1, 1}) (:366-368);
+    both Discard the response (:369)."""
+    ok = (AER, 1, 0, 2, 1, 2)           # success, mmatchIndex=2
+    s = init_state(DIMS).replace(
+        current_term=(2, 2, 2), role=(LEADER, FOLLOWER, FOLLOWER),
+        log=(((2, 1), (2, 2)), (), ()),
+        next_index=((1, 3, 1), (1, 1, 1), (1, 1, 1)),
+        messages=bag(ok))
+    assert_golden(expand, s, [s.replace(
+        next_index=((1, 3, 1), (1, 1, 1), (1, 1, 1)),
+        match_index=((0, 2, 0), (0, 0, 0), (0, 0, 0)),
+        messages=frozenset())])
+
+    fail = (AER, 1, 0, 2, 0, 0)
+    s2 = s.replace(messages=bag(fail))
+    assert_golden(expand, s2, [s2.replace(
+        next_index=((1, 2, 1), (1, 1, 1), (1, 1, 1)),
+        messages=frozenset())])
+
+    # Already at 1: Max({0, 1}) = 1 — the cursor floors, not underflows.
+    s3 = s.replace(next_index=((1, 1, 1), (1, 1, 1), (1, 1, 1)),
+                   messages=bag(fail))
+    assert_golden(expand, s3, [s3.replace(messages=frozenset())])

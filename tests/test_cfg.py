@@ -5,8 +5,7 @@ import os
 import pytest
 
 from raft_tla_tpu.utils.cfg import (load_config, parse_cfg,
-                                    scan_module_definitions,
-                                    scan_stop_after)
+                                    scan_module_definitions)
 
 REF = "/root/reference"
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -55,9 +54,11 @@ def test_module_definition_scan():
 
 
 def test_stop_after_scan():
+    from raft_tla_tpu.utils.cfg import scan_exit_operators
     text = ('StopAfter ==\n  \\/ TLCSet("exit", TLCGet("duration") > 7)\n'
             '  \\/ TLCSet("exit", TLCGet("diameter") > 42)\n')
-    assert scan_stop_after(text) == (7.0, 42)
+    op = scan_exit_operators(text)["StopAfter"]
+    assert op.conds == (("duration", 7.0), ("diameter", 42.0)) and op.pure
 
 
 def test_unknown_constant_raises(tmp_path):
@@ -142,3 +143,88 @@ def test_view_rejected_loudly(tmp_path):
                     "VIEW NoTermView\n")
     with pytest.raises(NotImplementedError, match="VIEW NoTermView"):
         load_config(str(cfgf))
+
+
+def test_scan_exit_operators():
+    """The general TLCGet/TLCSet coupling (SURVEY §5.5): any operator of the
+    Smokeraft StopAfter shape is recognized, per counter; parameterized
+    definitions bound operator bodies; block comments are stripped."""
+    from raft_tla_tpu.utils.cfg import scan_exit_operators
+    text = ('StopAfter ==\n'
+            '    /\\ TLCSet("exit", TLCGet("duration") > 7)\n'
+            '    /\\ TLCSet("exit", TLCGet("diameter") > 42)\n'
+            'Helper(x) ==\n'
+            '    TLCSet("exit", TLCGet("distinct") > 5)\n'
+            'BigRun ==\n'
+            '    TLCSet("exit", TLCGet("distinct") > 1000000)\n'
+            'Mixed ==\n'
+            '    /\\ TLCSet("exit", TLCGet("distinct") > 10)\n'
+            '    /\\ x < 5\n'
+            'Commented == (* TLCSet("exit", TLCGet("level") > 5) *) 3\n')
+    ops = scan_exit_operators(text)
+    assert ops["StopAfter"].conds == (("duration", 7.0), ("diameter", 42.0))
+    assert ops["StopAfter"].pure
+    # Helper(x)'s condition must NOT leak into StopAfter's body.
+    assert ops["Helper"].conds == (("distinct", 5.0),)
+    assert ops["BigRun"].conds == (("distinct", 1000000.0),)
+    assert not ops["Mixed"].pure        # budget + predicate conjunct
+    assert "Commented" not in ops       # block comment stripped
+
+
+def test_unknown_exit_counter_rejected_only_when_used(tmp_path):
+    """An unused operator with an unknown counter must not poison the load;
+    naming it as CONSTRAINT must reject loudly."""
+    cfg_path = _write_exit_model(tmp_path, "level", 10)
+    with pytest.raises(NotImplementedError, match="level"):
+        load_config(cfg_path)
+    # Same operator, no CONSTRAINT naming it: loads fine.
+    text = (tmp_path / "tiny.cfg").read_text()
+    (tmp_path / "tiny.cfg").write_text(
+        text.replace("CONSTRAINT StopEarly\n", ""))
+    s = load_config(str(tmp_path / "tiny.cfg"))
+    assert s.exit_conditions == ()
+
+
+def test_mixed_budget_predicate_constraint_rejected(tmp_path):
+    (tmp_path / "mix.tla").write_text(
+        "---- MODULE mix ----\nEXTENDS raft\n"
+        'Bounded ==\n    /\\ TLCSet("exit", TLCGet("distinct") > 10)\n'
+        "    /\\ Len(log[r1]) < 5\n====\n")
+    (tmp_path / "mix.cfg").write_text(
+        "CONSTANTS\n    Server = {r1}\n    Value = {v1}\n"
+        "SPECIFICATION Spec\nCONSTRAINT Bounded\n")
+    with pytest.raises(NotImplementedError, match="Bounded"):
+        load_config(str(tmp_path / "mix.cfg"))
+
+
+def _write_exit_model(tmp_path, counter, threshold):
+    (tmp_path / "tiny.tla").write_text(
+        "---- MODULE tiny ----\nEXTENDS raft\n"
+        f'StopEarly ==\n    TLCSet("exit", TLCGet("{counter}") '
+        f"> {threshold})\n====\n")
+    cfgf = tmp_path / "tiny.cfg"
+    cfgf.write_text(
+        "CONSTANTS\n    Server = {r1, r2, r3}\n    Value = {v1}\n"
+        "    Follower = Follower\n    Candidate = Candidate\n"
+        "    Leader = Leader\n    Nil = Nil\n"
+        "    RequestVoteRequest = RequestVoteRequest\n"
+        "    RequestVoteResponse = RequestVoteResponse\n"
+        "    AppendEntriesRequest = AppendEntriesRequest\n"
+        "    AppendEntriesResponse = AppendEntriesResponse\n"
+        "SPECIFICATION Spec\nINVARIANT TypeOK\nCONSTRAINT StopEarly\n")
+    return str(cfgf)
+
+
+def test_distinct_budget_constraint_loads(tmp_path):
+    """A cfg-defined constraint over TLCGet("distinct") needs no code
+    changes: it loads as an exit condition, not a state predicate."""
+    s = load_config(_write_exit_model(tmp_path, "distinct", 500))
+    assert s.exit_conditions == (("distinct", 500.0),)
+    assert s.constraints == []          # consumed as a budget
+    assert s.max_seconds is None and s.max_diameter is None
+
+
+def test_smokeraft_stopafter_still_routes_to_native_budgets():
+    s = load_config(f"{REF}/Smokeraft.cfg")
+    assert s.max_seconds == 1.0 and s.max_diameter == 100
+    assert s.exit_conditions == ()

@@ -374,3 +374,36 @@ def test_checkpoint_resume_across_spill(tmp_path):
     assert got.distinct == want.distinct
     assert got.levels == want.levels
     assert got.diameter == want.diameter
+
+
+def test_distinct_budget_stops_run(tmp_path):
+    """A5 proper (SURVEY §5.5): a cfg-defined constraint consulting
+    TLCGet("distinct") stops the run without any code changes — the general
+    metrics-control coupling, not a special-cased budget."""
+    from raft_tla_tpu.engine.check import initial_states, make_engine
+    from tests.test_cfg import _write_exit_model
+    from raft_tla_tpu.utils.cfg import load_config
+    setup = load_config(_write_exit_model(tmp_path, "distinct", 500))
+    eng = make_engine(setup, EngineConfig(
+        batch=64, queue_capacity=1 << 14, seen_capacity=1 << 16,
+        record_trace=False, sync_every=4))
+    res = eng.run(initial_states(setup))
+    assert res.stop_reason == "distinct_budget"
+    assert res.distinct > 500
+    # Promptness: one sync_every chunk (4 batches x G lanes) past the
+    # threshold at most — not a whole level of the unbounded model.
+    assert res.distinct < 500 + 4 * 64 * setup.dims.n_instances
+    assert res.violation is None
+
+
+def test_generated_budget_stops_run(tmp_path):
+    from raft_tla_tpu.engine.check import initial_states, make_engine
+    from tests.test_cfg import _write_exit_model
+    from raft_tla_tpu.utils.cfg import load_config
+    setup = load_config(_write_exit_model(tmp_path, "generated", 2000))
+    eng = make_engine(setup, EngineConfig(
+        batch=64, queue_capacity=1 << 14, seen_capacity=1 << 16,
+        record_trace=False, sync_every=4))
+    res = eng.run(initial_states(setup))
+    assert res.stop_reason == "generated_budget"
+    assert res.generated > 2000

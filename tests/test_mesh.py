@@ -221,3 +221,18 @@ def test_dryrun_ground_truth_pinned():
     assert res.stop_reason == "exhausted"
     assert res.distinct == 46553 and res.diameter == 31
     assert res.generated == want.generated_states
+
+
+def test_mesh_distinct_budget_stops_run(tmp_path):
+    """The TLCGet("distinct") budget must stop the mesh engine too (the
+    counters are psum-accumulated on the host side, same as single-chip)."""
+    from raft_tla_tpu.engine.check import initial_states, make_engine
+    from tests.test_cfg import _write_exit_model
+    from raft_tla_tpu.utils.cfg import load_config
+    setup = load_config(_write_exit_model(tmp_path, "distinct", 500))
+    eng = make_engine(setup, EngineConfig(
+        batch=16, queue_capacity=1 << 13, seen_capacity=1 << 16,
+        record_trace=False, sync_every=4), engine_cls=MeshBFSEngine)
+    res = eng.run(initial_states(setup))
+    assert res.stop_reason == "distinct_budget"
+    assert res.distinct > 500

@@ -1,0 +1,70 @@
+"""Every scripts/*.py entry point must run from a fresh clone (round 2
+proved they rot silently; VERDICT r3 item 9).  Each is smoke-invoked in a
+subprocess on CPU with tiny sizes — exit 0 and a sanity-check of stdout is
+the contract; real measurement happens on hardware via tpu_session.sh."""
+
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def run_script(args, extra_env=None, timeout=600):
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop("XLA_FLAGS", None)      # scripts run single-device
+    env.update(extra_env or {})
+    proc = subprocess.run([sys.executable] + args, cwd=REPO, env=env,
+                          capture_output=True, text=True, timeout=timeout)
+    assert proc.returncode == 0, (
+        f"{args} failed rc={proc.returncode}\n--- stdout\n{proc.stdout}"
+        f"\n--- stderr\n{proc.stderr[-3000:]}")
+    return proc.stdout
+
+
+def test_profile_step_runs():
+    out = run_script(["scripts/profile_step.py", "64"])
+    assert "expand" in out and "insert" in out
+
+
+def test_profile_fpset_runs():
+    out = run_script(["scripts/profile_fpset.py"],
+                     extra_env={"FPSET_C": str(1 << 14),
+                                "FPSET_K": str(1 << 10)})
+    assert "hash insert" in out
+
+
+def test_true_bench_runs():
+    out = run_script(["scripts/true_bench.py"],
+                     extra_env={"TB_BATCH": "64"})
+    assert "ms/iter" in out
+
+
+def test_leader_bench_runs():
+    """The leader-rich bench must actually exercise the log-machinery
+    kernels (ClientRequest/AppendEntries/AdvanceCommitIndex > 0 is asserted
+    inside the script itself)."""
+    out = run_script(["scripts/leader_bench.py", "3", "64"])
+    rec = json.loads(out.strip().splitlines()[-1])
+    assert rec["leader_family_share"] > 0.05
+    assert rec["seeds"] > 0
+
+
+def test_oracle_exhaust_level_capped(tmp_path):
+    out = run_script(["scripts/oracle_exhaust.py",
+                      "configs/MCraft_bounded.cfg",
+                      str(tmp_path / "oracle.jsonl"), "2"])
+    rec = json.loads(out.strip().splitlines()[-1])
+    # Level-2 prefix of the pinned MCraft_bounded profile
+    # (tests/test_engine.py::MCRAFT_BOUNDED_LEVELS, oracle_exhaust.jsonl).
+    assert rec["levels"] == [1, 3, 18]
+    assert rec["distinct"] == 22 and rec["generated"] == 33
+    assert rec["diameter"] == 2
+
+
+def test_bench_runs_with_tiny_budget():
+    out = run_script(["bench.py"], extra_env={"BENCH_SECONDS": "3"},
+                     timeout=900)
+    rec = json.loads(out.strip().splitlines()[-1])
+    assert {"metric", "value", "unit", "vs_baseline"} <= set(rec)
