@@ -132,6 +132,10 @@ class EngineResult:
     deadlock: Optional[PyState] = None
     stop_reason: str = "exhausted"
     wall_seconds: float = 0.0
+    # Seen-set growth events as (capacity-after, stall-seconds) — off the
+    # duration clock, recorded as evidence for up-front SEEN_CAPACITY
+    # sizing (each is a rehash + retrace on the growing engine).
+    growth_stalls: List = dataclasses.field(default_factory=list)
 
     @property
     def states_per_second(self) -> float:
@@ -452,6 +456,7 @@ class BFSEngine:
         elif init_states is None:
             raise ValueError("need init_states or resume")
         res = EngineResult()
+        self._growth_stalls = res.growth_stalls
         t_enter = time.time()   # for early returns before the budget clock
         # Trace recording off => plain dict store (never written); avoids
         # triggering the native build for runs that measure raw throughput.
@@ -617,9 +622,12 @@ class BFSEngine:
                     res.stop_reason = "duration_budget"
                     break
                 if base and cfg.exit_conditions:
+                    # "queue" during ingest: enqueued rows + landed spills
+                    # + the roots not yet ingested.
                     hit = _exit_condition_hit(
                         cfg.exit_conditions, res,
-                        int(next_count) + spill_next.total_rows())
+                        int(next_count) + spill_next.total_rows()
+                        + (len(rows_np) - base))
                     if hit:
                         res.stop_reason = hit
                         break
@@ -795,10 +803,17 @@ class BFSEngine:
                     if cfg.exit_conditions:
                         # Checked last: a violation or deadlock in the same
                         # chunk outranks a budget stop (TLC reports the
-                        # error, not the exit).
+                        # error, not the exit).  TLC's "queue" counter is
+                        # the FULL unexplored-state queue: the unexpanded
+                        # remainder of this level (device rows + host
+                        # segments) plus everything enqueued for the next
+                        # (device rows + landed and in-flight spills).
+                        queue_rows = (
+                            (cur_count - offset) + pending.total_rows()
+                            + next_count_h + spill_next.total_rows()
+                            + sum(c for _b, c in inflight))
                         hit = _exit_condition_hit(
-                            cfg.exit_conditions, res,
-                            next_count_h + spill_next.total_rows())
+                            cfg.exit_conditions, res, queue_rows)
                         if hit:
                             res.stop_reason = hit
                             break
@@ -883,7 +898,12 @@ class BFSEngine:
                               jnp.int32(next_count), seen, tbuf,
                               jnp.int32(0), jnp.int32(1))
             qnext, seen, tbuf = out[0], out[1], out[2]
-            t0 += time.time() - t_grow
+            stall = time.time() - t_grow
+            t0 += stall
+            # Off the clock, but recorded: a run that starts undersized
+            # pays one of these per doubling — the evidence for sizing
+            # SEEN_CAPACITY up front.
+            self._growth_stalls.append((len(seen.hi), round(stall, 3)))
         return seen, qnext, tbuf, t0
 
     def _maybe_grow_seen(self, seen, size=None):

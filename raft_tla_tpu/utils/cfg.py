@@ -401,10 +401,14 @@ def load_config(cfg_path: str, max_log: Optional[int] = None,
                 raise NotImplementedError(
                     f'TLCGet("{counter}") in CONSTRAINT {name} not '
                     f"supported; available engine counters: {EXIT_COUNTERS}")
+            # TLC exits when ANY TLCSet("exit", ...) trips, so when the
+            # same counter is bounded twice the SMALLEST threshold wins.
             if counter == "duration":
-                max_seconds = threshold
+                max_seconds = threshold if max_seconds is None \
+                    else min(max_seconds, threshold)
             elif counter == "diameter":
-                max_diameter = int(threshold)
+                max_diameter = int(threshold) if max_diameter is None \
+                    else min(max_diameter, int(threshold))
             else:
                 exit_conditions.append((counter, threshold))
 

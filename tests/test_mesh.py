@@ -101,6 +101,9 @@ def test_mesh_seen_set_grows():
     got = small.run([init_state(DIMS)])
     assert got.distinct == want.distinct
     assert got.levels == want.levels
+    # (Per-shard capacity is floored at fpset's minimum, so this run does
+    # not grow; growth evidence is asserted by
+    # test_dryrun_ground_truth_pinned.)
 
 
 def test_mesh_checkpoint_resumes_on_mesh_and_single(tmp_path):
@@ -212,15 +215,22 @@ def test_dryrun_ground_truth_pinned():
                    constraint=constraint_py(bounds), check_deadlock=False)
     assert want.distinct_states == 46553
     assert len(want.levels) - 1 == 31    # diameter
+    # Exactly the driver's dryrun_multichip config (__graft_entry__.py):
+    # batch 64 keeps the per-shard table floor at 8K=8192, so the 46.5k-key
+    # run crosses the half-load threshold and exercises shard growth too.
     eng = MeshBFSEngine(
         dims, constraint=build_constraint(dims, bounds),
-        config=EngineConfig(batch=256, queue_capacity=1 << 12,
+        config=EngineConfig(batch=64, queue_capacity=1 << 12,
                             seen_capacity=1 << 16, check_deadlock=False,
                             record_trace=False, sync_every=8))
     res = eng.run([init_state(dims)])
     assert res.stop_reason == "exhausted"
     assert res.distinct == 46553 and res.diameter == 31
     assert res.generated == want.generated_states
+    # 46,553 keys over 8 shards in 8k-per-shard tables: shard growth must
+    # fire and be recorded as (total-capacity-after, stall seconds).
+    caps = [c for c, _s in res.growth_stalls]
+    assert caps and caps == sorted(caps) and len(set(caps)) == len(caps)
 
 
 def test_mesh_distinct_budget_stops_run(tmp_path):
