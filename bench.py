@@ -131,6 +131,9 @@ def main():
         "levels": res.levels,
         "stop_reason": res.stop_reason,
         "generated_by_action": res.action_counts,
+        # Seen-set doublings as (capacity-after, off-clock stall seconds):
+        # the cost evidence for sizing SEEN_CAPACITY up front.
+        "growth_stalls": res.growth_stalls,
         "baseline_states_per_sec": round(base_rate, 1),
         "baseline_distinct": ores.distinct_states,
         "baseline_wall_s": round(base_wall, 2),

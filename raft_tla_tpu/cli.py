@@ -68,6 +68,11 @@ def main(argv=None):
     c.add_argument("--spill-dir", default=None,
                    help="memory-map spilled level segments here (TLC's "
                         "disk-backed state queue) instead of host RAM")
+    c.add_argument("--progress-seconds", type=float, default=None,
+                   help="stderr progress line cadence (TLC's ~per-minute "
+                        "report: generated/distinct/rate/queue); 0 "
+                        "disables; default 60 (flag > cfg PROGRESS_SECONDS "
+                        "directive > default)")
 
     s = sub.add_parser("simulate", help="random-trace simulation")
     common(s)
@@ -136,7 +141,9 @@ def main(argv=None):
             checkpoint_interval_seconds=float(
                 resolve(args.checkpoint_interval,
                         "CHECKPOINT_INTERVAL", 60.0)),
-            spill_dir=resolve(args.spill_dir, "SPILL_DIR", None))
+            spill_dir=resolve(args.spill_dir, "SPILL_DIR", None),
+            progress_interval_seconds=float(
+                resolve(args.progress_seconds, "PROGRESS_SECONDS", 60.0)))
         engine_cls = args.engine if args.engine == "auto" else None
         if args.engine == "mesh":
             from .parallel.mesh import MeshBFSEngine

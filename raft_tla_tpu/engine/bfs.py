@@ -99,6 +99,10 @@ class EngineConfig:
     # live counters after every chunk stats fetch, stop_reason
     # "<counter>_budget".  duration/diameter ride the two fields above.
     exit_conditions: tuple = ()
+    # TLC prints a progress line roughly every minute; 0 disables.  The
+    # CLI defaults this to 60 for `check` runs (SURVEY §5.1: duration,
+    # diameter, states/sec, queue as live counters).
+    progress_interval_seconds: float = 0.0
     checkpoint_dir: Optional[str] = None  # R8: level-boundary snapshots
     checkpoint_every: int = 1             # snapshot every k levels...
     checkpoint_interval_seconds: float = 0.0  # ...but at most this often.
@@ -146,6 +150,18 @@ class EngineResult:
 # re-exported here for compatibility.
 from .trace import PyTraceStore as TraceStore  # noqa: E402
 from .trace import make_trace_store  # noqa: E402
+
+
+def _progress_line(res, t0, queue_rows, level_frontier):
+    """TLC-style progress line (its ~per-minute report: states generated,
+    distinct states, states left on queue), written to stderr by the
+    engines when progress_interval_seconds is set."""
+    import sys as _sys
+    dt = max(time.time() - t0, 1e-9)
+    print(f"progress: {res.generated:,} generated, {res.distinct:,} "
+          f"distinct ({res.distinct / dt:,.0f}/s), diameter "
+          f"{res.diameter} (expanding {level_frontier:,}), queue "
+          f"{queue_rows:,}, elapsed {dt:,.0f}s", file=_sys.stderr)
 
 
 def _exit_condition_hit(conds, res, queue_rows):
@@ -558,6 +574,7 @@ class BFSEngine:
                           jnp.int32(self._CH))
         qnext, seen, tbuf = out[0], out[1], out[2]
         t0 = time.time()
+        last_progress = t0
         self._batch_ema = 0.0   # measured seconds per device batch
 
         if resume is not None:
@@ -800,18 +817,29 @@ class BFSEngine:
                             unflatten_state(np.asarray(out[4]), dims), dims)
                         res.stop_reason = "deadlock"
                         break
-                    if cfg.exit_conditions:
-                        # Checked last: a violation or deadlock in the same
-                        # chunk outranks a budget stop (TLC reports the
-                        # error, not the exit).  TLC's "queue" counter is
-                        # the FULL unexplored-state queue: the unexpanded
-                        # remainder of this level (device rows + host
-                        # segments) plus everything enqueued for the next
-                        # (device rows + landed and in-flight spills).
+                    want_progress = bool(
+                        cfg.progress_interval_seconds
+                        and time.time() - last_progress
+                        >= cfg.progress_interval_seconds)
+                    if cfg.exit_conditions or want_progress:
+                        # TLC's "queue" counter is the FULL unexplored-
+                        # state queue: the unexpanded remainder of this
+                        # level (device rows + host segments) plus
+                        # everything enqueued for the next (device rows +
+                        # landed and in-flight spills).
+                        # offset advances in batch multiples and may
+                        # overshoot cur_count on the level's last chunk.
                         queue_rows = (
-                            (cur_count - offset) + pending.total_rows()
+                            max(0, cur_count - offset)
+                            + pending.total_rows()
                             + next_count_h + spill_next.total_rows()
                             + sum(c for _b, c in inflight))
+                        if want_progress:
+                            _progress_line(res, t0, queue_rows, cur_count)
+                            last_progress = time.time()
+                        # Checked last: a violation or deadlock in the same
+                        # chunk outranks a budget stop (TLC reports the
+                        # error, not the exit).
                         hit = _exit_condition_hit(
                             cfg.exit_conditions, res, queue_rows)
                         if hit:

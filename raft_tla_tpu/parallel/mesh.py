@@ -53,8 +53,9 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..engine.chunk import build_chunk_body
 from ..engine.bfs import (EngineConfig, EngineResult, TraceStore, Violation,
-                          _exit_condition_hit, build_root_check,
-                          find_root_violation, make_trace_store)
+                          _exit_condition_hit, _progress_line,
+                          build_root_check, find_root_violation,
+                          make_trace_store)
 from ..models.actions import build_expand
 from ..models.dims import RaftDims
 from ..models.invariants import build_inv_id
@@ -471,6 +472,7 @@ class MeshBFSEngine:
                           tcount, jnp.int32(self._CH), jnp.int32(0))
         qnext, next_counts, shi, slo, ssize, tbuf = out[:6]
         t0 = time.time()
+        last_progress = t0
         self._batch_ema = 0.0
 
         if resume is not None:
@@ -676,9 +678,11 @@ class MeshBFSEngine:
                             np.asarray(drow)[d], dims), dims)
                         res.stop_reason = "deadlock"
                         break
-                    if cfg.exit_conditions:
-                        # Last: a violation/deadlock in the same chunk
-                        # outranks a budget stop (engine/bfs.py rationale).
+                    want_progress = bool(
+                        cfg.progress_interval_seconds
+                        and time.time() - last_progress
+                        >= cfg.progress_interval_seconds)
+                    if cfg.exit_conditions or want_progress:
                         # "queue" counts the FULL unexplored queue across
                         # all chips: this level's remainder + next-level
                         # rows + landed and in-flight spill segments.
@@ -689,6 +693,12 @@ class MeshBFSEngine:
                             + int(np.asarray(next_counts).sum())
                             + spill_next.total_rows()
                             + sum(int(c.sum()) for _b, c in inflight))
+                        if want_progress:
+                            _progress_line(res, t0, queue_rows,
+                                           int(np.asarray(cur_counts).sum()))
+                            last_progress = time.time()
+                        # Last: a violation/deadlock in the same chunk
+                        # outranks a budget stop (engine/bfs.py rationale).
                         hit = _exit_condition_hit(
                             cfg.exit_conditions, res, queue_rows)
                         if hit:

@@ -228,3 +228,13 @@ def test_smokeraft_stopafter_still_routes_to_native_budgets():
     s = load_config(f"{REF}/Smokeraft.cfg")
     assert s.max_seconds == 1.0 and s.max_diameter == 100
     assert s.exit_conditions == ()
+
+
+def test_progress_seconds_backend_directive(tmp_path):
+    """PROGRESS_SECONDS rides the same flag > directive > default chain as
+    every other backend key."""
+    cfgf = tmp_path / "p.cfg"
+    cfgf.write_text("\\* TPU: PROGRESS_SECONDS = 300\n"
+                    "CONSTANT Server = {r1}\nCONSTANT Value = {v1}\n")
+    s = load_config(str(cfgf))
+    assert s.backend["PROGRESS_SECONDS"] == 300

@@ -484,3 +484,19 @@ def test_duplicate_duration_budgets_min_wins(tmp_path):
     s = load_config(str(tmp_path / "two.cfg"))
     assert s.max_seconds == 5.0
     assert s.max_diameter == 7
+
+
+def test_progress_lines_emitted(capfd):
+    """progress_interval_seconds produces TLC-style stderr progress lines
+    with live counters; the default (0) stays silent."""
+    eng = BFSEngine(DIMS, constraint=build_constraint(DIMS, BOUNDS),
+                    config=small_config(max_diameter=3,
+                                        progress_interval_seconds=1e-6))
+    eng.run([init_state(DIMS)])
+    err = capfd.readouterr().err
+    assert "progress:" in err and "queue" in err and "distinct" in err
+
+    quiet = BFSEngine(DIMS, constraint=build_constraint(DIMS, BOUNDS),
+                      config=small_config(max_diameter=3))
+    quiet.run([init_state(DIMS)])
+    assert "progress:" not in capfd.readouterr().err

@@ -246,3 +246,12 @@ def test_mesh_distinct_budget_stops_run(tmp_path):
     res = eng.run(initial_states(setup))
     assert res.stop_reason == "distinct_budget"
     assert res.distinct > 500
+
+
+def test_mesh_progress_lines_emitted(capfd):
+    eng = MeshBFSEngine(DIMS, constraint=build_constraint(DIMS, BOUNDS),
+                        config=small_mesh_config(
+                            max_diameter=3, progress_interval_seconds=1e-6))
+    eng.run([init_state(DIMS)])
+    err = capfd.readouterr().err
+    assert "progress:" in err and "queue" in err
