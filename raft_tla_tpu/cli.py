@@ -105,6 +105,22 @@ def main(argv=None):
     if platform:
         _force_platform(platform)
 
+    # Multi-host launch contract (parallel/multihost.py): export
+    # RAFT_COORDINATOR / RAFT_NUM_PROCESSES / RAFT_PROCESS_ID and run the
+    # SAME command on every host; the process group forms before any
+    # device is touched and jax.devices() becomes the global mesh.
+    if os.environ.get("RAFT_COORDINATOR"):
+        from .parallel import multihost as _mh
+        _mh.initialize()
+        if args.cmd == "check":
+            # The exhaustive mesh BFS host loop is single-controller (its
+            # queue/spill management reads sharded arrays); running it in
+            # a process group would die mid-run on a non-addressable
+            # np.asarray or hang a collective.  Refuse up front.
+            p.error("multi-host mode (RAFT_COORDINATOR) currently supports "
+                    "the 'simulate' command only; run 'check' on one host "
+                    "over its local slice")
+
     from .engine.bfs import EngineConfig
     from .engine.check import (format_result, initial_states, make_engine)
     from .models.pystate import format_state
@@ -191,7 +207,10 @@ def main(argv=None):
     if args.engine == "auto":
         import jax
         devs = jax.devices()
-        use_mesh = len(devs) > 1 and devs[0].platform != "cpu"
+        # Multi-process: the global-mesh fleet IS the multi-host mode —
+        # anything else would run N duplicate local simulations.
+        use_mesh = (jax.process_count() > 1
+                    or (len(devs) > 1 and devs[0].platform != "cpu"))
     if use_mesh:
         from .parallel.simulate import MeshSimulator as Simulator
     else:

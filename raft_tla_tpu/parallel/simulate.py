@@ -21,7 +21,7 @@ from typing import Callable, Dict, List, Optional
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.sharding import Mesh, PartitionSpec as P
 
 from ..engine.simulate import SimResult, Simulator, build_sim_chunk
 from ..models.dims import RaftDims
@@ -53,16 +53,30 @@ class MeshSimulator:
             rows_o, _roots, tstep_o, cur_root_o, abuf_o, restarts, \
                 latch = carry
             vf, vinv, vroot, vlen, vacts, vchoice = latch
+            # Everything the host READS is psum-replicated so the loop is
+            # multi-controller-safe (parallel/multihost.py rules): the
+            # lowest-indexed latched chip's violation wins everywhere.
+            idx = jax.lax.axis_index("x")
+            far = jnp.int32(1 << 30)
+            chosen = jax.lax.pmin(jnp.where(vf, idx, far), "x")
+            sel = vf & (idx == chosen)
+
+            def bcast(v):
+                return jax.lax.psum(jnp.where(sel, v, jnp.zeros_like(v)),
+                                    "x")
+
             return (rows_o[None], tstep_o[None], cur_root_o[None],
-                    abuf_o[None], restarts[None], vf[None], vinv[None],
-                    vroot[None], vlen[None], vacts[None], vchoice[None])
+                    abuf_o[None], jax.lax.psum(restarts, "x"),
+                    chosen < far, bcast(vinv), bcast(vroot), bcast(vlen),
+                    bcast(vacts), bcast(vchoice))
 
         shard = partial(jax.shard_map, mesh=self.mesh, check_vma=False)
         sx, rep = P("x"), P()
         self._chunk = jax.jit(shard(
             sharded,
             in_specs=(sx, rep, sx, sx, sx, sx),
-            out_specs=(sx,) * 11), donate_argnums=(0, 4))
+            out_specs=(sx, sx, sx, sx) + (rep,) * 7),
+            donate_argnums=(0, 4))
 
         # Root checking + replay reuse the single-chip machinery (its
         # chunk program is jit-lazy and never traced here — only
@@ -74,43 +88,58 @@ class MeshSimulator:
     # ------------------------------------------------------------------
     def run(self, roots: List[PyState], num_steps: int, seed: int = 0,
             max_seconds: Optional[float] = None) -> SimResult:
+        from . import multihost as mh
         dims, n, B, D = self.dims, self.n_dev, self.batch, self.depth
         res = SimResult()
         t0 = time.time()
         roots_np = self._single._prepare_roots(roots, res, t0)
         if roots_np is None:
             return res
-        roots_j = jnp.asarray(roots_np)
+        mesh = self.mesh
 
-        sh = NamedSharding(self.mesh, P("x"))
+        # All inputs are computed identically on every process (same seed)
+        # and sharded via put_global — each process materializes only its
+        # own shards, so the same code drives one host or a DCN cluster.
         key = jax.random.PRNGKey(seed)
         key, sub = jax.random.split(key)
         start = np.asarray(
             jax.random.randint(sub, (n, B), 0, len(roots))).astype(np.int32)
-        rows = jax.device_put(roots_np[start], sh)
-        cur_root = jax.device_put(start, sh)
-        tstep = jax.device_put(np.zeros((n, B), np.int32), sh)
-        abuf = jax.device_put(np.zeros((n, B, D), np.int32), sh)
+        roots_j = mh.put_global(roots_np, mesh, P())
+        rows = mh.put_global(roots_np[start], mesh, P("x"))
+        cur_root = mh.put_global(start, mesh, P("x"))
+        tstep = mh.put_global(np.zeros((n, B), np.int32), mesh, P("x"))
+        abuf = mh.put_global(np.zeros((n, B, D), np.int32), mesh, P("x"))
         res.traces = n * B
+        # Wall clocks differ per host: a duration stop must be agreed
+        # collectively or the processes' trip counts diverge and the next
+        # all_to_all deadlocks (multihost.py rule 4).  The agreement round
+        # trip is only paid when it can matter (multi-process AND a
+        # duration budget; max_seconds is identical everywhere, so the
+        # gate itself is collective-safe).
+        any_flag = (mh.build_any(mesh)
+                    if mh.is_multiprocess() and max_seconds is not None
+                    else None)
 
         while res.steps < num_steps:
             key, sub = jax.random.split(key)
-            keys = jax.device_put(
-                np.asarray(jax.random.split(sub, n)), sh)
+            keys = mh.put_global(np.asarray(jax.random.split(sub, n)),
+                                 mesh, P("x"))
             out = self._chunk(rows, roots_j, tstep, cur_root, abuf, keys)
-            (rows, tstep, cur_root, abuf, restarts, vf, vinv, vroot,
-             vlen, vacts, vchoice) = out
+            (rows, tstep, cur_root, abuf, g_restarts, g_vf, g_vinv,
+             g_vroot, g_vlen, g_vacts, g_vchoice) = out
             res.steps += n * B * self.chunk
-            res.traces += int(np.asarray(restarts).sum())
-            vf_h = np.asarray(vf)
-            if vf_h.any():
-                d = int(np.argmax(vf_h))
+            res.traces += int(np.asarray(g_restarts))
+            if bool(np.asarray(g_vf)):
                 self._single._reconstruct(
-                    res, roots, int(np.asarray(vinv)[d]),
-                    int(np.asarray(vroot)[d]), int(np.asarray(vlen)[d]),
-                    np.asarray(vacts)[d], int(np.asarray(vchoice)[d]))
+                    res, roots, int(np.asarray(g_vinv)),
+                    int(np.asarray(g_vroot)), int(np.asarray(g_vlen)),
+                    np.asarray(g_vacts), int(np.asarray(g_vchoice)))
                 break
-            if max_seconds is not None and time.time() - t0 > max_seconds:
+            over = (max_seconds is not None
+                    and time.time() - t0 > max_seconds)
+            if any_flag is not None:
+                over = any_flag(over)
+            if over:
                 break
         res.wall_seconds = time.time() - t0
         return res
