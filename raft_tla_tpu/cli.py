@@ -112,14 +112,18 @@ def main(argv=None):
     if os.environ.get("RAFT_COORDINATOR"):
         from .parallel import multihost as _mh
         _mh.initialize()
-        if args.cmd == "check":
-            # The exhaustive mesh BFS host loop is single-controller (its
-            # queue/spill management reads sharded arrays); running it in
-            # a process group would die mid-run on a non-addressable
-            # np.asarray or hang a collective.  Refuse up front.
-            p.error("multi-host mode (RAFT_COORDINATOR) currently supports "
-                    "the 'simulate' command only; run 'check' on one host "
-                    "over its local slice")
+        if args.engine == "single":
+            # A per-process single-chip engine inside a process group
+            # would run N duplicate full checks; the global mesh is the
+            # multi-host mode.
+            p.error("multi-host mode (RAFT_COORDINATOR) requires "
+                    "--engine mesh or auto")
+        args.engine = "mesh"
+        if args.cmd == "check" and not args.no_trace:
+            # The trace store is per-controller; the engine would refuse
+            # anyway — say it in CLI terms.
+            p.error("multi-host check requires --no-trace "
+                    "(counterexample traces are not multi-host yet)")
 
     from .engine.bfs import EngineConfig
     from .engine.check import (format_result, initial_states, make_engine)

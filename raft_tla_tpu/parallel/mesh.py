@@ -223,11 +223,14 @@ class MeshBFSEngine:
             compactor=compactor, insert_fn=route_insert)
 
         def sharded_chunk(qcur, cur_counts, offset0, qnext, next_counts,
-                          shi, slo, ssize, tbuf, tcount0, max_steps,
-                          max_count):
+                          shi, slo, ssize, tbuf, tcount0, max_steps):
             # Shapes inside shard_map: leading device axis of size 1.
             qcur_l, qnext_l = qcur[0], qnext[0]
             cnt_l, ncnt_l = cur_counts[0], next_counts[0]
+            # The level width is derived IN-program (pmax over chips), so
+            # the host never needs a global view of the per-chip counts —
+            # a multi-controller requirement (parallel/multihost.py).
+            max_count = jax.lax.pmax(cnt_l, "x")
             seen_l = fpset.FPSet(hi=shi[0], lo=slo[0], size=ssize[0])
             tbuf_l = tuple(t[0] for t in tbuf)
             init = (offset0, jnp.int32(0), qnext_l, ncnt_l, seen_l, tbuf_l,
@@ -264,19 +267,35 @@ class MeshBFSEngine:
             g_new = jax.lax.psum(newc, "x")
             g_ovf = jax.lax.psum(ovfc, "x")
             g_fail = jax.lax.psum(fail_any.astype(_I32), "x")
-            # per-family counts ride in the same packed stats vector
-            # (one host fetch per call — engine/bfs.py contract).
+            # Violation/deadlock rows are broadcast from the lowest-indexed
+            # flagged chip so EVERY host reads identical replicated values
+            # — no per-chip inspection on the host side.
+            from .multihost import bcast_lowest_flagged
+            v_any, vinv_g, vrow_g, vhi_g, vlo_g = bcast_lowest_flagged(
+                "x", viol_any, vinv, vrow, vhi, vlo)
+            d_any, drow_g = bcast_lowest_flagged("x", dead_any, drow)
+
+            # Packed replicated stats: one host fetch per call
+            # (engine/bfs.py contract).  Layout documented at the read
+            # site in run().
             stats = jnp.concatenate([
-                jnp.stack([offset, steps, g_gen, g_new, g_ovf, g_fail]),
+                jnp.stack([offset, steps, g_gen, g_new, g_ovf, g_fail,
+                           max_count,
+                           jax.lax.pmax(ncnt_l, "x"),
+                           jax.lax.psum(ncnt_l, "x"),
+                           jax.lax.psum(
+                               jnp.maximum(cnt_l - offset, 0), "x"),
+                           jax.lax.pmax(seen_l.size, "x"),
+                           v_any.astype(_I32),
+                           d_any.astype(_I32),
+                           vinv_g,
+                           jax.lax.psum(cnt_l, "x")]),
                 jax.lax.psum(fam_counts, "x")])
-            local = jnp.stack([ncnt_l, seen_l.size, tcnt_l,
-                               dead_any.astype(_I32), viol_any.astype(_I32),
-                               vinv])
+            vfp_g = jnp.stack([vhi_g, vlo_g])
             return (qnext_l[None], ncnt_l[None], seen_l.hi[None],
                     seen_l.lo[None], seen_l.size[None],
                     tuple(t[None] for t in tbuf_l), tcnt_l[None],
-                    stats[None], local[None], drow[None], vrow[None],
-                    jnp.stack([vhi, vlo])[None])
+                    stats, drow_g, vrow_g, vfp_g)
 
         def sharded_ingest(rows, valid, qnext, next_counts, shi, slo, ssize,
                            tbuf, tcount0):
@@ -290,28 +309,39 @@ class MeshBFSEngine:
              vinfo) = local_absorb(
                 rows_l, states, valid_l, sent, sent, acts,
                 qnext[0], next_counts[0], seen_l, tbuf_l, tcount0[0])
-            g_new = jax.lax.psum(n_new, "x")
-            g_fail = jax.lax.psum(fail.astype(_I32), "x")
+            viol_any, vinv, vrow, vhi, vlo = vinfo
+            # Replicated stats + lowest-flagged-chip violation broadcast
+            # (sharded_chunk rationale): the host reads no per-chip values.
+            from .multihost import bcast_lowest_flagged
+            v_any, vinv_g, vrow_g, vhi_g, vlo_g = bcast_lowest_flagged(
+                "x", viol_any, vinv, vrow, vhi, vlo)
+            stats = jnp.stack([
+                jax.lax.psum(n_new, "x"),
+                jax.lax.psum(fail.astype(_I32), "x"),
+                jax.lax.pmax(ncnt_l, "x"),
+                jax.lax.psum(ncnt_l, "x"),
+                v_any.astype(_I32),
+                vinv_g,
+                jax.lax.pmax(seen_l.size, "x")])
+            vfp = jnp.stack([vhi_g, vlo_g])
             return (qnext_l[None], ncnt_l[None], seen_l.hi[None],
                     seen_l.lo[None], seen_l.size[None],
                     tuple(t[None] for t in tbuf_l), tcnt_l[None],
-                    g_new[None], g_fail[None],
-                    tuple(jnp.asarray(x)[None] for x in vinfo))
+                    stats, vrow_g, vfp)
 
         shard = partial(jax.shard_map, mesh=self.mesh, check_vma=False)
         sx = P("x")
         rep = P()
         self._chunk = jax.jit(shard(
             sharded_chunk,
-            in_specs=(sx, sx, rep, sx, sx, sx, sx, sx, sx, sx, rep, rep),
-            out_specs=(sx, sx, sx, sx, sx, (sx,) * 5, sx, sx, sx, sx, sx,
-                       sx)),
+            in_specs=(sx, sx, rep, sx, sx, sx, sx, sx, sx, sx, rep),
+            out_specs=(sx, sx, sx, sx, sx, (sx,) * 5, sx, rep, rep, rep,
+                       rep)),
             donate_argnums=(3, 5, 6, 7, 8))
         self._ingest = jax.jit(shard(
             sharded_ingest,
             in_specs=(sx, sx, sx, sx, sx, sx, sx, sx, sx),
-            out_specs=(sx, sx, sx, sx, sx, (sx,) * 5, sx, sx, sx,
-                       (sx,) * 5)),
+            out_specs=(sx, sx, sx, sx, sx, (sx,) * 5, sx, rep, rep, rep)),
             donate_argnums=(2, 4, 5, 6, 7))
 
         def fp_rows(rows):
@@ -326,20 +356,38 @@ class MeshBFSEngine:
 
     # ------------------------------------------------------------------
     def _grow_seen(self, shi, slo, ssize, new_cl=None):
-        """Rebuild every shard at double (or given) capacity.  Owner
-        assignment (fp_hi mod n) is capacity-independent, so keys stay on
-        their chips; the chunk program recompiles for the new shape."""
+        """Rebuild this controller's shards at double (or given) capacity.
+        Owner assignment (fp_hi mod n) is capacity-independent, so keys
+        stay on their chips; every controller rehashes only its
+        addressable shards and the arrays are reassembled shard-by-shard
+        (multi-controller rule 3).  The chunk program recompiles for the
+        new shape — identically everywhere."""
         n = self.n_dev
-        new_cl = new_cl or 2 * self._CL
-        hi_h, lo_h = np.asarray(shi), np.asarray(slo)
-        shards = []
-        for d in range(n):
-            real = ~((hi_h[d] == SENTINEL) & (lo_h[d] == SENTINEL))
-            shards.append(fpset.from_host_keys(
-                hi_h[d][real], lo_h[d][real], new_cl))
-        self._CL = fpset._capacity(new_cl)
+        new_cl = fpset._capacity(new_cl or 2 * self._CL)
+
+        def by_row(arr):
+            return {s.index[0].start: np.asarray(s.data)[0]
+                    for s in arr.addressable_shards}
+
+        his, los = by_row(shi), by_row(slo)
+        hi_b, lo_b, sz_b = {}, {}, {}
+        for d, hi_h in his.items():
+            lo_h = los[d]
+            real = ~((hi_h == SENTINEL) & (lo_h == SENTINEL))
+            s = fpset.from_host_keys(hi_h[real], lo_h[real], new_cl)
+            hi_b[d] = np.asarray(s.hi)[None]
+            lo_b[d] = np.asarray(s.lo)[None]
+            sz_b[d] = np.asarray(s.size, np.int32).reshape(1)
+        self._CL = new_cl
         self._rebuild_programs()
-        return self._stack_sharded(shards)
+        sh = NamedSharding(self.mesh, P("x"))
+        shi2 = jax.make_array_from_callback(
+            (n, new_cl), sh, lambda idx: hi_b[idx[0].start])
+        slo2 = jax.make_array_from_callback(
+            (n, new_cl), sh, lambda idx: lo_b[idx[0].start])
+        ssize2 = jax.make_array_from_callback(
+            (n,), sh, lambda idx: sz_b[idx[0].start])
+        return shi2, slo2, ssize2
 
     def _stack_sharded(self, shards):
         """Stack per-chip FPSet shards into (shi, slo, ssize) placed with
@@ -370,6 +418,7 @@ class MeshBFSEngine:
     def run(self, init_states: Optional[List[PyState]] = None,
             resume=None) -> EngineResult:
         from ..engine import checkpoint as ckpt_mod
+        from . import multihost as mh
         dims, cfg = self.dims, self.config
         n, sw, B, QL = self.n_dev, self._sw, self._B, self._QL
         if resume is not None and isinstance(resume, str):
@@ -379,6 +428,27 @@ class MeshBFSEngine:
                 f"checkpoint dims {resume.dims} != engine dims {dims}")
         if resume is None and init_states is None:
             raise ValueError("need init_states or resume")
+        mp = mh.is_multiprocess()
+        if mp:
+            # Multi-controller scope (parallel/multihost.py): the compiled
+            # programs and the queue/spill/growth loop below are
+            # multi-host-clean; these features still gather global state
+            # to one host and are refused loudly rather than wrong.
+            if cfg.record_trace:
+                raise NotImplementedError(
+                    "multi-host check requires record_trace=False "
+                    "(--no-trace): the trace store is per-controller")
+            if cfg.checkpoint_dir is not None or resume is not None:
+                raise NotImplementedError(
+                    "multi-host checkpoint/resume not supported yet")
+            if any(c == "queue" for c, _t in cfg.exit_conditions):
+                raise NotImplementedError(
+                    'TLCGet("queue") budgets are not multi-host-safe yet '
+                    "(the spill pools are per-controller)")
+        # Collective agreement on host-local facts (clocks); identical-
+        # everywhere decisions skip the round trip (multihost.py rule 4).
+        any_flag = mh.build_any(self.mesh) if mp else None
+        budget_agree = mh.build_budget_agree(self.mesh) if mp else None
         res = EngineResult()
         self._growth_stalls = res.growth_stalls
         t_enter = time.time()
@@ -429,8 +499,11 @@ class MeshBFSEngine:
             while inflight:
                 arr, cnts = inflight.pop(0)
                 # _drain copies per-chip slices (np.concatenate), so no
-                # view into the recycled buffer survives.
-                spill_next.append(self._drain(np.asarray(arr), cnts))
+                # view into the recycled buffer survives.  A controller
+                # whose shards were all empty contributes no segment.
+                rows = self._drain(arr, cnts)
+                if len(rows):
+                    spill_next.append(rows)
                 free_q.append(arr)
 
         if resume is None:
@@ -454,22 +527,27 @@ class MeshBFSEngine:
                     trace.roots.setdefault(
                         (int(rhi[idx]) << 32) | int(rlo[idx]), s)
 
-        # Warm-up compilation before the duration clock starts.
-        out = self._ingest(jnp.zeros((n, B, sw), jnp.uint8),
-                           jnp.zeros((n, B), bool),
-                           qnext, next_counts, shi, slo, ssize, tbuf,
-                           tcount)
+        # Warm-up compilation before the duration clock starts.  Inputs go
+        # through put_global so each controller materializes only its own
+        # shards (multihost.py rule 3; identical single-host).
+        zero_counts = mh.put_global(np.zeros((n,), np.int32),
+                                    self.mesh, P("x"))
+        out = self._ingest(
+            mh.put_global(np.zeros((n, B, sw), ROW_DTYPE),
+                          self.mesh, P("x")),
+            mh.put_global(np.zeros((n, B), bool), self.mesh, P("x")),
+            qnext, next_counts, shi, slo, ssize, tbuf, tcount)
         qnext, next_counts, shi, slo, ssize, tbuf = out[:6]
-        out = self._chunk(qcur, jnp.zeros((n,), _I32), jnp.int32(0),
+        out = self._chunk(qcur, zero_counts, jnp.int32(0),
                           qnext, next_counts, shi, slo, ssize, tbuf,
-                          tcount, jnp.int32(self._CH), jnp.int32(0))
+                          tcount, jnp.int32(self._CH))
         qnext, next_counts, shi, slo, ssize, tbuf = out[:6]
         # Placement-fixpoint second call (engine/bfs.py warm-up rationale):
         # free when outputs already carry the input shardings, and
         # pre-compiles the output-placement variant when they don't.
-        out = self._chunk(qcur, jnp.zeros((n,), _I32), jnp.int32(0),
+        out = self._chunk(qcur, zero_counts, jnp.int32(0),
                           qnext, next_counts, shi, slo, ssize, tbuf,
-                          tcount, jnp.int32(self._CH), jnp.int32(0))
+                          tcount, jnp.int32(self._CH))
         qnext, next_counts, shi, slo, ssize, tbuf = out[:6]
         t0 = time.time()
         last_progress = t0
@@ -492,7 +570,8 @@ class MeshBFSEngine:
             # rewrite the whole tail per upload in disk-backed mode.
             for i in range(0, len(fr), n * QL):
                 pending.append(fr[i:i + n * QL])
-            cur_counts = np.zeros((n,), np.int64)
+            cur_counts_dev = zero_counts
+            level_rows = len(fr)
             res.distinct = resume.distinct
             res.generated = resume.generated
             res.diameter = resume.diameter
@@ -519,20 +598,25 @@ class MeshBFSEngine:
             # Ingest roots round-robin across chips in B-sized waves.
             per_chip = [rows_np[i::n] for i in range(n)]
             max_chunks = max((-(-len(p) // B) for p in per_chip), default=0)
+            drained = 0       # next-level rows pushed to host pools (global)
+            cur_sum = 0       # next-level rows on device (replicated psum)
             for c in range(max_chunks):
                 # StopAfter covers ingest; the first wave always runs
-                # (engine/bfs.py rationale).
-                if c and cfg.max_seconds is not None \
-                        and time.time() - t0 > cfg.max_seconds:
-                    res.stop_reason = "duration_budget"
-                    break
+                # (engine/bfs.py rationale).  Clock decisions are agreed
+                # collectively under multi-controller.
+                if c and cfg.max_seconds is not None:
+                    over = time.time() - t0 > cfg.max_seconds
+                    if any_flag is not None:
+                        over = any_flag(over)
+                    if over:
+                        res.stop_reason = "duration_budget"
+                        break
                 if c and cfg.exit_conditions:
                     # "queue" during ingest: enqueued + landed spills +
                     # roots not yet ingested (engine/bfs.py rationale).
                     hit = _exit_condition_hit(
                         cfg.exit_conditions, res,
-                        int(np.asarray(next_counts).sum())
-                        + spill_next.total_rows()
+                        cur_sum + spill_next.total_rows()
                         + sum(max(0, len(p) - c * B) for p in per_chip))
                     if hit:
                         res.stop_reason = hit
@@ -543,44 +627,52 @@ class MeshBFSEngine:
                     part = per_chip[d][c * B:(c + 1) * B]
                     wave[d, :len(part)] = part
                     valid[d, :len(part)] = True
-                out = self._ingest(jnp.asarray(wave), jnp.asarray(valid),
+                out = self._ingest(mh.put_global(wave, self.mesh, P("x")),
+                                   mh.put_global(valid, self.mesh, P("x")),
                                    qnext, next_counts, shi, slo, ssize,
                                    tbuf, tcount)
-                (qnext, next_counts, shi, slo, ssize, tbuf, tcount, g_new,
-                 g_fail, vinfo) = out
-                res.distinct += int(np.asarray(g_new)[0])
-                if int(np.asarray(g_fail)[0]):
+                (qnext, next_counts, shi, slo, ssize, tbuf, tcount,
+                 istats, ivrow, ivfp) = out
+                ist = np.asarray(istats)
+                res.distinct += int(ist[0])
+                cur_sum = int(ist[3])
+                if int(ist[1]):
                     raise RuntimeError("seen-set probe failure during "
                                        "ingest; raise seen_capacity")
                 self._flush_trace(trace, tbuf, tcount)
-                tcount = jnp.zeros((n,), _I32)
+                tcount = sharded_full((n,), _I32)
                 (shi, slo, ssize, qnext, next_counts, tbuf,
                  t0) = self._grow_precompiled(shi, slo, ssize, qcur, qnext,
-                                              next_counts, tbuf, tcount, t0)
-                nc = np.asarray(next_counts)
-                if int(nc.max()) > self._QTH:   # ingest adds <= B per wave
-                    spill_next.append(self._drain(qnext, nc))
-                    next_counts = jnp.zeros((n,), _I32)
-                if self._check_violation_ingest(res, vinfo):
+                                              next_counts, tbuf, tcount,
+                                              t0, int(ist[6]))
+                if int(ist[2]) > self._QTH:  # ingest adds <= B per wave
+                    rows = self._drain(
+                        qnext, self._local_counts(next_counts))
+                    if len(rows):
+                        spill_next.append(rows)
+                    drained += cur_sum
+                    cur_sum = 0
+                    next_counts = sharded_full((n,), _I32)
+                if self._check_violation_ingest(res, ist, ivrow, ivfp):
                     break
-            res.levels.append(int(np.asarray(next_counts).sum())
-                              + spill_next.total_rows())
+            level_rows = drained + cur_sum
+            res.levels.append(level_rows)
             qcur, qnext = qnext, qcur
-            cur_counts = np.asarray(next_counts).copy()
-            next_counts = jnp.zeros((n,), _I32)
+            cur_counts_dev = next_counts
+            next_counts = sharded_full((n,), _I32)
             pending, spill_next = spill_next, pending
 
         skip_ckpt_level = resume.diameter if resume is not None else -1
         last_ckpt = time.time() if resume is not None else float("-inf")
-        while (cur_counts.sum() > 0 or pending) \
+        while level_rows > 0 \
                 and res.violation is None and res.stop_reason == "exhausted":
             if cfg.checkpoint_dir is not None \
                     and res.diameter % max(1, cfg.checkpoint_every) == 0 \
                     and res.diameter != skip_ckpt_level \
                     and (time.time() - last_ckpt
                          >= cfg.checkpoint_interval_seconds):
-                self._write_checkpoint(qcur, cur_counts, pending, shi, slo,
-                                       res, trace,
+                self._write_checkpoint(qcur, cur_counts_dev, pending, shi,
+                                       slo, res, trace,
                                        wall=time.time() - t0)
                 last_ckpt = time.time()
             if cfg.max_diameter is not None \
@@ -589,18 +681,20 @@ class MeshBFSEngine:
                 break
             # Level loop over segments: device-resident rows first, then
             # host-pool segments (balanced re-uploads).  Budgeted runs
-            # slow-start each level (engine/bfs.py rationale).
+            # slow-start each level (engine/bfs.py rationale).  The level
+            # width is derived in-program (pmax), so the sub-loop is
+            # do-while: one call, then loop while the replicated offset
+            # has not crossed the replicated width.
             calls_in_level = 0
+            drained = 0
+            cur_sum = 0
             while True:
                 offset = 0
-                max_count = int(cur_counts.max()) if len(cur_counts) else 0
-                while offset < max_count:
+                while True:
                     allowed = self._CH
                     if cfg.max_seconds is not None:
                         remaining = cfg.max_seconds - (time.time() - t0)
-                        if remaining <= 0:
-                            res.stop_reason = "duration_budget"
-                            break
+                        over = remaining <= 0
                         if self._batch_ema:
                             # Half-window sizing + per-level slow-start
                             # (engine/bfs.py rationale)
@@ -611,17 +705,25 @@ class MeshBFSEngine:
                         else:
                             allowed = 1    # no estimate yet: probe batch
                                            # (engine/bfs.py rationale)
+                        if budget_agree is not None:
+                            # allowed is an input to a collective program:
+                            # all controllers must pass the same value —
+                            # one fused round trip agrees both the stop
+                            # flag and the chunk budget.
+                            over, allowed = budget_agree(over, allowed)
+                            allowed = max(1, allowed)
+                        if over:
+                            res.stop_reason = "duration_budget"
+                            break
                     calls_in_level += 1
                     t_call = time.time()
                     out = self._chunk(
-                        qcur, jnp.asarray(cur_counts, _I32),
+                        qcur, cur_counts_dev,
                         jnp.int32(offset), qnext, next_counts, shi, slo,
-                        ssize, tbuf, tcount, jnp.int32(allowed),
-                        jnp.int32(max_count))
+                        ssize, tbuf, tcount, jnp.int32(allowed))
                     (qnext, next_counts, shi, slo, ssize, tbuf, tcount,
-                     stats, local, drow, vrow, vhl) = out
-                    st = np.asarray(stats)[0]
-                    lc = np.asarray(local)
+                     stats, drow_g, vrow_g, vfp_g) = out
+                    st = np.asarray(stats)
                     if int(st[1]):
                         per = (time.time() - t_call) / int(st[1])
                         # Conservative: jump up instantly, decay slowly
@@ -630,10 +732,12 @@ class MeshBFSEngine:
                             per if not self._batch_ema else
                             max(per, 0.5 * self._batch_ema + 0.5 * per))
                     offset = int(st[0])
+                    max_count = int(st[6])
+                    cur_sum = int(st[8])
                     res.generated += int(st[2])
                     res.distinct += int(st[3])
                     if int(st[2]):
-                        for name, c in zip(dims.family_names, st[6:]):
+                        for name, c in zip(dims.family_names, st[15:]):
                             res.action_counts[name] = (
                                 res.action_counts.get(name, 0) + int(c))
                     if int(st[4]):
@@ -648,34 +752,42 @@ class MeshBFSEngine:
                             "one chunk); raise seen_capacity or lower "
                             "sync_every")
                     self._flush_trace(trace, tbuf, tcount)
-                    tcount = jnp.zeros((n,), _I32)
+                    tcount = sharded_full((n,), _I32)
                     (shi, slo, ssize, qnext, next_counts, tbuf,
                      t0) = self._grow_precompiled(
                         shi, slo, ssize, qcur, qnext, next_counts, tbuf,
-                        tcount, t0)
-                    ncnt = lc[:, 0]
-                    if int(ncnt.max()) > self._QTH \
-                            and (offset < max_count or pending):
-                        resolve_spill()
-                        qnext.copy_to_host_async()
-                        inflight.append((qnext, ncnt.copy()))
-                        qnext = free_q.pop()
-                        next_counts = jnp.zeros((n,), _I32)
-                    viol_chips = lc[:, 4]
-                    if viol_chips.any():
-                        d = int(np.argmax(viol_chips))
-                        vh = np.asarray(vhl)[d]
+                        tcount, t0, int(st[10]))
+                    if int(st[7]) > self._QTH:
+                        # Watermark (replicated pmax): drain unless this is
+                        # the level's very last chunk — then the boundary
+                        # swap is cheaper.  "More segments?" is host-local
+                        # state, agreed collectively when it matters.
+                        more_here = offset < max_count
+                        if not more_here:
+                            more_here = (any_flag(bool(pending))
+                                         if any_flag is not None
+                                         else bool(pending))
+                        if more_here:
+                            resolve_spill()
+                            cnts = self._local_counts(next_counts)
+                            qnext.copy_to_host_async()
+                            inflight.append((qnext, cnts))
+                            qnext = free_q.pop()
+                            next_counts = sharded_full((n,), _I32)
+                            drained += cur_sum
+                            cur_sum = 0
+                    if int(st[11]):
+                        vf = np.asarray(vfp_g)
                         res.violation = Violation(
-                            invariant=self.inv_names[int(lc[d, 5])],
+                            invariant=self.inv_names[int(st[13])],
                             state=decode_state(unflatten_state(
-                                np.asarray(vrow)[d], dims), dims),
-                            fingerprint=(int(vh[0]) << 32) | int(vh[1]))
+                                np.asarray(vrow_g), dims), dims),
+                            fingerprint=(int(vf[0]) << 32) | int(vf[1]))
                         res.stop_reason = "violation"
                         break
-                    if lc[:, 3].any() and self._check_deadlock:
-                        d = int(np.argmax(lc[:, 3]))
+                    if int(st[12]) and self._check_deadlock:
                         res.deadlock = decode_state(unflatten_state(
-                            np.asarray(drow)[d], dims), dims)
+                            np.asarray(drow_g), dims), dims)
                         res.stop_reason = "deadlock"
                         break
                     want_progress = bool(
@@ -683,19 +795,16 @@ class MeshBFSEngine:
                         and time.time() - last_progress
                         >= cfg.progress_interval_seconds)
                     if cfg.exit_conditions or want_progress:
-                        # "queue" counts the FULL unexplored queue across
-                        # all chips: this level's remainder + next-level
-                        # rows + landed and in-flight spill segments.
+                        # "queue" counts the FULL unexplored queue: this
+                        # level's remainder (replicated psum) + next-level
+                        # rows + landed and in-flight spill segments
+                        # (this controller's pools; global single-host).
                         queue_rows = (
-                            int(np.maximum(
-                                np.asarray(cur_counts) - offset, 0).sum())
-                            + pending.total_rows()
-                            + int(np.asarray(next_counts).sum())
-                            + spill_next.total_rows()
-                            + sum(int(c.sum()) for _b, c in inflight))
+                            int(st[9]) + pending.total_rows()
+                            + cur_sum + spill_next.total_rows()
+                            + sum(sum(c.values()) for _b, c in inflight))
                         if want_progress:
-                            _progress_line(res, t0, queue_rows,
-                                           int(np.asarray(cur_counts).sum()))
+                            _progress_line(res, t0, queue_rows, int(st[14]))
                             last_progress = time.time()
                         # Last: a violation/deadlock in the same chunk
                         # outranks a budget stop (engine/bfs.py rationale).
@@ -704,65 +813,99 @@ class MeshBFSEngine:
                         if hit:
                             res.stop_reason = hit
                             break
+                    if offset >= max_count:
+                        break
+                more_segments = (any_flag(bool(pending))
+                                 if any_flag is not None else bool(pending))
                 if res.stop_reason != "exhausted" \
-                        or res.violation is not None or not pending:
+                        or res.violation is not None or not more_segments:
                     break
-                # Upload the next host segment, balanced across chips.
-                seg = pending.pop(0)
-                while len(seg) > n * QL:
-                    pending.insert(0, seg[n * QL:])
-                    seg = seg[:n * QL]
-                buf = np.zeros((n, QLA, sw), ROW_DTYPE)
-                cur_counts = np.zeros((n,), np.int64)
-                share = -(-len(seg) // n)
-                for d in range(n):
-                    part = seg[d * share:(d + 1) * share]
-                    buf[d, :len(part)] = part
-                    cur_counts[d] = len(part)
-                qcur = jax.device_put(buf, NamedSharding(self.mesh, P("x")))
+                # Upload the next host segment, balanced across this
+                # controller's chips (each controller re-uploads its own
+                # pool; the segment cap keeps any one upload within QL
+                # rows per chip).
+                my_rows = [i for i, d in
+                           enumerate(self.mesh.devices.flat)
+                           if d.process_index == jax.process_index()]
+                cap = len(my_rows) * QL
+                seg = pending.pop(0) if pending else \
+                    np.zeros((0, sw), ROW_DTYPE)
+                while len(seg) > cap:
+                    pending.insert(0, seg[cap:])
+                    seg = seg[:cap]
+                bufs = {}
+                cnts = np.zeros((n,), np.int32)
+                share = -(-len(seg) // len(my_rows)) if len(seg) else 0
+                for k, di in enumerate(my_rows):
+                    part = seg[k * share:(k + 1) * share] if share else \
+                        seg[:0]
+                    b = np.zeros((QLA, sw), ROW_DTYPE)
+                    b[:len(part)] = part
+                    bufs[di] = b[None]
+                    cnts[di] = len(part)
+                shq = NamedSharding(self.mesh, P("x"))
+                qcur = jax.make_array_from_callback(
+                    (n, QLA, sw), shq, lambda idx: bufs[idx[0].start])
+                cur_counts_dev = jax.make_array_from_callback(
+                    (n,), shq, lambda idx: cnts[idx[0].start:idx[0].stop])
             if res.stop_reason != "exhausted" or res.violation is not None:
                 break
             resolve_spill()      # level boundary: all drains must land
             res.diameter += 1
-            nc = np.asarray(next_counts)
-            res.levels.append(int(nc.sum())
-                              + spill_next.total_rows())
+            level_rows = drained + cur_sum
+            res.levels.append(level_rows)
             qcur, qnext = qnext, qcur
-            cur_counts = nc.copy()
-            next_counts = jnp.zeros((n,), _I32)
+            cur_counts_dev = next_counts
+            next_counts = sharded_full((n,), _I32)
             pending, spill_next = spill_next, pending
 
         res.wall_seconds = time.time() - t0
         return res
 
     # ------------------------------------------------------------------
-    def _drain(self, qnext, ncnt) -> np.ndarray:
-        """All chips' queued rows -> one host array (spill)."""
-        rows = np.asarray(qnext)
-        return np.concatenate([rows[d, :int(ncnt[d])]
-                               for d in range(self.n_dev)]) \
-            if int(np.asarray(ncnt).sum()) else \
+    def _local_counts(self, counts) -> dict:
+        """{global chip row -> count} for THIS controller's addressable
+        shards (single-controller: all chips — behavior unchanged)."""
+        return {s.index[0].start: int(np.asarray(s.data)[0])
+                for s in counts.addressable_shards}
+
+    def _drain(self, qnext, cnts: dict) -> np.ndarray:
+        """This controller's queued rows -> one host array (spill).  Each
+        controller drains only its addressable shards; the union across
+        controllers is the global queue (multi-controller rule 2)."""
+        segs = []
+        for s in sorted(qnext.addressable_shards,
+                        key=lambda s: s.index[0].start):
+            c = cnts.get(s.index[0].start, 0)
+            if c:
+                segs.append(np.asarray(s.data)[0, :c])
+        return np.concatenate(segs) if segs else \
             np.zeros((0, self._sw), ROW_DTYPE)
 
-    def _maybe_grow(self, shi, slo, ssize):
-        if int(np.asarray(ssize).max()) <= self._CL // 2:
+    def _maybe_grow(self, shi, slo, ssize, max_ssize):
+        """``max_ssize`` is the psum-replicated pmax of shard loads (from
+        the packed stats), so every controller takes the same branch."""
+        if max_ssize <= self._CL // 2:
             return shi, slo, ssize
         return self._grow_seen(shi, slo, ssize)
 
     def _grow_precompiled(self, shi, slo, ssize, qcur, qnext, next_counts,
-                          tbuf, tcount, t0):
+                          tbuf, tcount, t0, max_ssize):
         """Grow the seen shards when loaded past threshold, pre-compile
         the rebuilt programs at the new shape with a zero-trip call, and
         keep the rehash + compile off the duration clock (engine/bfs.py
         rule).  Returns (shi, slo, ssize, qnext, next_counts, tbuf, t0)."""
         t_grow = time.time()
-        grown = self._maybe_grow(shi, slo, ssize)
+        grown = self._maybe_grow(shi, slo, ssize, max_ssize)
         if grown[0] is not shi:
             shi, slo, ssize = grown
+            from . import multihost as mh
+            zero_counts = mh.put_global(
+                np.zeros((self.n_dev,), np.int32), self.mesh, P("x"))
             out = self._chunk(
-                qcur, jnp.zeros((self.n_dev,), _I32), jnp.int32(0), qnext,
+                qcur, zero_counts, jnp.int32(0), qnext,
                 next_counts, shi, slo, ssize, tbuf, tcount,
-                jnp.int32(1), jnp.int32(0))
+                jnp.int32(1))
             qnext, next_counts, shi, slo, ssize, tbuf = out[:6]
             stall = time.time() - t_grow
             t0 += stall
@@ -788,7 +931,7 @@ class MeshBFSEngine:
             ta = np.empty(0, np.int32)
             roots = {}
         frontier, front_cleanup = pending.concat_with(
-            self._drain(qcur, cur_counts))
+            self._drain(qcur, self._local_counts(cur_counts)))
         hi_h, lo_h = np.asarray(shi), np.asarray(slo)
         keys_hi, keys_lo = [], []
         for d in range(self.n_dev):
@@ -830,18 +973,17 @@ class MeshBFSEngine:
                        | pl[d, :m].astype(np.uint64))
             trace.add_batch(fps, parents, ac[d, :m])
 
-    def _check_violation_ingest(self, res, vinfo) -> bool:
-        viol_any = np.asarray(vinfo[0])
-        if not viol_any.any():
+    def _check_violation_ingest(self, res, ist, vrow, vfp) -> bool:
+        """``ist``/``vrow``/``vfp`` are the ingest program's replicated
+        stats and lowest-flagged-chip violation broadcast."""
+        if not int(ist[4]):
             return False
-        d = int(np.argmax(viol_any))
-        st = decode_state(
-            unflatten_state(np.asarray(vinfo[2])[d], self.dims), self.dims)
-        fp = (int(np.asarray(vinfo[3])[d]) << 32) \
-            | int(np.asarray(vinfo[4])[d])
+        vf = np.asarray(vfp)
         res.violation = Violation(
-            invariant=self.inv_names[int(np.asarray(vinfo[1])[d])],
-            state=st, fingerprint=fp)
+            invariant=self.inv_names[int(ist[5])],
+            state=decode_state(
+                unflatten_state(np.asarray(vrow), self.dims), self.dims),
+            fingerprint=(int(vf[0]) << 32) | int(vf[1]))
         res.stop_reason = "violation"
         return True
 

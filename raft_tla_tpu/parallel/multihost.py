@@ -46,6 +46,7 @@ import os
 from functools import partial
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
@@ -88,17 +89,76 @@ def put_per_process(value: int, mesh: Mesh):
         (n,), NamedSharding(mesh, P("x")), lambda idx: local[idx])
 
 
-def build_any(mesh: Mesh):
-    """Compiled agreement primitive: per-process flags -> one replicated
-    'did anyone flag?' boolean (psum over the device axis)."""
+def _build_agree(mesh: Mesh, reduce_fn):
+    """One compiled psum/pmin-style reduction over per-process int32
+    values: the shared plumbing behind every agreement primitive."""
 
-    def agree(flags):
-        return jax.lax.psum(flags[0], "x")
+    def agree(vals):
+        return reduce_fn(vals[0], "x")
 
-    fn = jax.jit(partial(jax.shard_map, mesh=mesh, check_vma=False)(
+    return jax.jit(partial(jax.shard_map, mesh=mesh, check_vma=False)(
         agree, in_specs=P("x"), out_specs=P()))
+
+
+def build_any(mesh: Mesh):
+    """Agreement primitive: per-process flags -> one replicated 'did
+    anyone flag?' boolean."""
+    fn = _build_agree(mesh, jax.lax.psum)
 
     def any_flag(value: bool) -> bool:
         return bool(np.asarray(fn(put_per_process(int(value), mesh))) > 0)
 
     return any_flag
+
+
+def build_min(mesh: Mesh):
+    """Agreement primitive for VALUES: every process contributes an int,
+    all read back the minimum — e.g. agreeing on a chunk-size budget
+    derived from per-host clocks (the conservative choice never overshoots
+    a deadline)."""
+    fn = _build_agree(mesh, jax.lax.pmin)
+
+    def min_val(value: int) -> int:
+        return int(np.asarray(fn(put_per_process(int(value), mesh))))
+
+    return min_val
+
+
+def build_budget_agree(mesh: Mesh):
+    """Fused per-chunk budget agreement — ONE cross-host round trip for
+    the pair every budgeted chunk needs: (any process over deadline?,
+    min of the per-process chunk-size budgets)."""
+    n = mesh.devices.size
+
+    def agree(vals):
+        v = vals[0]
+        return jnp.stack([jax.lax.psum(v[0], "x"),
+                          jax.lax.pmin(v[1], "x")])
+
+    fn = jax.jit(partial(jax.shard_map, mesh=mesh, check_vma=False)(
+        agree, in_specs=P("x"), out_specs=P()))
+
+    def budget(over: bool, allowed: int):
+        local = np.tile(np.asarray([int(over), int(allowed)], np.int32),
+                        (n, 1))
+        arr = jax.make_array_from_callback(
+            (n, 2), NamedSharding(mesh, P("x")), lambda idx: local[idx])
+        out = np.asarray(fn(arr))
+        return bool(out[0] > 0), int(out[1])
+
+    return budget
+
+
+def bcast_lowest_flagged(axis: str, flag, *values):
+    """Inside a shard_map'd program: broadcast ``values`` from the
+    lowest-axis-indexed shard whose ``flag`` is set, so every shard (and
+    hence every controller) reads identical replicated results.  Returns
+    (any_flag_set, broadcast_values...)."""
+    idx = jax.lax.axis_index(axis)
+    far = jnp.int32(1 << 30)
+    chosen = jax.lax.pmin(jnp.where(flag, idx, far), axis)
+    sel = flag & (idx == chosen)
+    out = tuple(
+        jax.lax.psum(jnp.where(sel, v, jnp.zeros_like(v)), axis)
+        for v in values)
+    return (chosen < far,) + out

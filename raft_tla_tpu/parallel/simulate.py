@@ -56,19 +56,13 @@ class MeshSimulator:
             # Everything the host READS is psum-replicated so the loop is
             # multi-controller-safe (parallel/multihost.py rules): the
             # lowest-indexed latched chip's violation wins everywhere.
-            idx = jax.lax.axis_index("x")
-            far = jnp.int32(1 << 30)
-            chosen = jax.lax.pmin(jnp.where(vf, idx, far), "x")
-            sel = vf & (idx == chosen)
-
-            def bcast(v):
-                return jax.lax.psum(jnp.where(sel, v, jnp.zeros_like(v)),
-                                    "x")
-
+            from .multihost import bcast_lowest_flagged
+            (g_vf, g_vinv, g_vroot, g_vlen, g_vacts,
+             g_vchoice) = bcast_lowest_flagged(
+                "x", vf, vinv, vroot, vlen, vacts, vchoice)
             return (rows_o[None], tstep_o[None], cur_root_o[None],
                     abuf_o[None], jax.lax.psum(restarts, "x"),
-                    chosen < far, bcast(vinv), bcast(vroot), bcast(vlen),
-                    bcast(vacts), bcast(vchoice))
+                    g_vf, g_vinv, g_vroot, g_vlen, g_vacts, g_vchoice)
 
         shard = partial(jax.shard_map, mesh=self.mesh, check_vma=False)
         sx, rep = P("x"), P()

@@ -1,0 +1,60 @@
+"""Worker for the multi-host exhaustive-BFS test (not a pytest module).
+
+Two processes, one global 4-device mesh: the full distributed pipeline —
+expand -> fingerprint -> owner-routed all_to_all dedup ACROSS HOSTS ->
+sharded FPSet insert -> enqueue, with per-controller spill pools — must
+exhaust a bounded 2-server model and report the oracle-pinned counts
+(4,779 distinct / diameter 25 / 12,584 generated) identically on every
+controller."""
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from raft_tla_tpu.utils.platform import neutralize_axon_if_cpu_requested
+
+neutralize_axon_if_cpu_requested()
+
+from raft_tla_tpu.parallel import multihost as mh  # noqa: E402
+
+mh.initialize()
+
+import jax  # noqa: E402
+
+from raft_tla_tpu.engine.bfs import EngineConfig  # noqa: E402
+from raft_tla_tpu.models.dims import RaftDims  # noqa: E402
+from raft_tla_tpu.models.invariants import (Bounds, build_constraint,  # noqa: E402
+                                            build_type_ok)
+from raft_tla_tpu.models.pystate import init_state  # noqa: E402
+from raft_tla_tpu.parallel.mesh import MeshBFSEngine  # noqa: E402
+
+
+def main():
+    dims = RaftDims(n_servers=2, n_values=1, max_log=2, n_msg_slots=8)
+    eng = MeshBFSEngine(
+        dims,
+        invariants={"TypeOK": build_type_ok(dims)},
+        constraint=build_constraint(
+            dims, Bounds(max_term=2, max_log_len=1, max_msg_count=1,
+                         max_in_flight=1)),
+        config=EngineConfig(batch=32, queue_capacity=1 << 10,
+                            seen_capacity=1 << 14, check_deadlock=False,
+                            record_trace=False, sync_every=4))
+    assert eng.n_dev == len(jax.devices())    # the GLOBAL mesh
+    res = eng.run([init_state(dims)])
+    print(json.dumps({
+        "process": jax.process_index(),
+        "global_devices": len(jax.devices()),
+        "distinct": res.distinct,
+        "generated": res.generated,
+        "diameter": res.diameter,
+        "levels": res.levels,
+        "stop_reason": res.stop_reason,
+        "violation": res.violation.invariant if res.violation else None,
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -19,7 +19,7 @@ import pytest
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
-def _spawn(pid, nproc, port, extra_env=None):
+def _spawn(pid, nproc, port, script="mh_sim_worker.py", extra_env=None):
     env = dict(os.environ,
                JAX_PLATFORMS="cpu",
                XLA_FLAGS="--xla_force_host_platform_device_count=2",
@@ -28,9 +28,25 @@ def _spawn(pid, nproc, port, extra_env=None):
                RAFT_PROCESS_ID=str(pid))
     env.update(extra_env or {})
     return subprocess.Popen(
-        [sys.executable, os.path.join(REPO, "tests", "mh_sim_worker.py")],
+        [sys.executable, os.path.join(REPO, "tests", script)],
         env=env, cwd=REPO, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
         text=True)
+
+
+def _run_pair(script, timeout=900):
+    port = _free_port()
+    procs = [_spawn(i, 2, port, script) for i in range(2)]
+    outs = []
+    for p in procs:
+        try:
+            out, err = p.communicate(timeout=timeout)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            pytest.fail("multi-host worker timed out (collective deadlock?)")
+        assert p.returncode == 0, f"worker failed:\n{err[-3000:]}"
+        outs.append(json.loads(out.strip().splitlines()[-1]))
+    return sorted(outs, key=lambda r: r["process"])
 
 
 def _free_port():
@@ -41,19 +57,7 @@ def _free_port():
 
 
 def test_two_process_simulation_agrees():
-    port = _free_port()
-    procs = [_spawn(i, 2, port) for i in range(2)]
-    outs = []
-    for p in procs:
-        try:
-            out, err = p.communicate(timeout=600)
-        except subprocess.TimeoutExpired:
-            for q in procs:
-                q.kill()
-            pytest.fail("multi-host worker timed out (collective deadlock?)")
-        assert p.returncode == 0, f"worker failed:\n{err[-3000:]}"
-        outs.append(json.loads(out.strip().splitlines()[-1]))
-    a, b = sorted(outs, key=lambda r: r["process"])
+    a, b = _run_pair("mh_sim_worker.py", timeout=600)
     assert (a["process"], b["process"]) == (0, 1)
     assert a["global_devices"] == b["global_devices"] == 4
     assert a["local_devices"] == b["local_devices"] == 2
@@ -83,3 +87,21 @@ def test_put_global_matches_device_put_single_host():
     rep = mh.put_global(arr, mesh, P())
     assert np.array_equal(np.asarray(rep), arr)
     assert not mh.is_multiprocess()
+
+
+def test_two_process_exhaustive_bfs_matches_oracle():
+    """The full distributed BFS pipeline across two controllers: owner-
+    routed all_to_all dedup crosses the process boundary, each controller
+    spills/re-uploads only its own shards, and BOTH report the oracle-
+    pinned exhaustion (4,779 distinct / diameter 25 / 12,584 generated,
+    models.oracle.bfs on the 2-server MaxInFlight=1 model)."""
+    a, b = _run_pair("mh_bfs_worker.py")
+    assert a["global_devices"] == b["global_devices"] == 4
+    for k in ("distinct", "generated", "diameter", "levels", "stop_reason",
+              "violation"):
+        assert a[k] == b[k], (k, a, b)
+    assert a["stop_reason"] == "exhausted"
+    assert a["violation"] is None
+    assert a["distinct"] == 4779
+    assert a["diameter"] == 25
+    assert a["generated"] == 12584
