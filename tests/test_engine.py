@@ -163,17 +163,17 @@ def test_five_server_north_star_model_matches_oracle():
     """The north-star model (configs/TPUraft.cfg: 5 servers, MaxTerm=4,
     MaxLogLen=4) against a pinned oracle prefix — extends the
     differential contract beyond the 3-server bench model.  Pinned by
-    models.oracle.bfs (max_levels=5), 2026-07-30."""
+    models.oracle.bfs (max_levels=7, 706,142 distinct), 2026-07-30."""
     from raft_tla_tpu.engine.check import initial_states, make_engine
     from raft_tla_tpu.utils.cfg import load_config
     setup = load_config("configs/TPUraft.cfg")
     eng = make_engine(setup, small_config(
-        batch=256, queue_capacity=1 << 15, seen_capacity=1 << 17,
-        max_diameter=5, record_trace=False))
+        batch=512, queue_capacity=1 << 19, seen_capacity=1 << 21,
+        max_diameter=7, record_trace=False))
     res = eng.run(initial_states(setup))
-    assert res.levels == [1, 5, 45, 310, 1995, 12306]
-    assert res.distinct == 17852
-    assert res.generated == 50900
+    assert res.levels == [1, 5, 45, 310, 1995, 12306, 72870, 417420]
+    assert res.distinct == 706142
+    assert res.generated == 2265410
     assert res.violation is None
 
 
