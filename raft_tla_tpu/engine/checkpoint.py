@@ -24,6 +24,7 @@ import dataclasses
 import json
 import os
 import pickle
+import re
 from typing import Dict, Optional, Tuple
 
 import numpy as np
@@ -96,7 +97,56 @@ def save(path: str, ckpt: Checkpoint) -> None:
         os.close(dfd)
 
 
+# Multi-host runs write one PIECE per controller (its frontier slice +
+# its seen-key shards; counters are psum-replicated so every piece
+# carries identical metadata): level_00012.p0of2.npz, .p1of2.npz, ...
+# load() on any piece merges the complete group, so a checkpoint written
+# by M controllers resumes on 1 or N controllers and vice versa (the
+# merged image is exactly the single-file format).  A shared filesystem
+# across hosts is assumed, as with TLC's distributed states/ dir.
+_PIECE_RE = re.compile(r"^(level_\d+)\.p(\d+)of(\d+)\.npz$")
+
+
+def piece_path(checkpoint_dir: str, diameter: int, pid: int,
+               nproc: int) -> str:
+    return os.path.join(checkpoint_dir,
+                        f"level_{diameter:05d}.p{pid}of{nproc}.npz")
+
+
+def _merge(pieces) -> Checkpoint:
+    base = pieces[0]
+    for p in pieces[1:]:
+        if p.dims != base.dims:
+            raise ValueError("checkpoint pieces disagree on dims")
+    hi = np.concatenate([p.seen_hi for p in pieces])
+    lo = np.concatenate([p.seen_lo for p in pieces])
+    order = np.lexsort((lo, hi))
+    return dataclasses.replace(
+        base,
+        frontier=np.concatenate([p.frontier for p in pieces]),
+        seen_hi=hi[order], seen_lo=lo[order],
+        trace_fps=np.concatenate([p.trace_fps for p in pieces]),
+        trace_parents=np.concatenate([p.trace_parents for p in pieces]),
+        trace_actions=np.concatenate([p.trace_actions for p in pieces]),
+        roots={k: v for p in pieces for k, v in p.roots.items()})
+
+
 def load(path: str) -> Checkpoint:
+    m = _PIECE_RE.match(os.path.basename(path))
+    if m:
+        base, nproc = m.group(1), int(m.group(3))
+        d = os.path.dirname(os.path.abspath(path))
+        paths = [os.path.join(d, f"{base}.p{i}of{nproc}.npz")
+                 for i in range(nproc)]
+        missing = [p for p in paths if not os.path.exists(p)]
+        if missing:
+            raise FileNotFoundError(
+                f"incomplete checkpoint piece group: missing {missing}")
+        return _merge([_load_one(p) for p in paths])
+    return _load_one(path)
+
+
+def _load_one(path: str) -> Checkpoint:
     with np.load(path) as z:
         meta = json.loads(bytes(z["meta"]).decode())
         if meta["version"] != FORMAT_VERSION:
@@ -120,25 +170,35 @@ def load(path: str) -> Checkpoint:
 
 
 def latest(checkpoint_dir: str) -> Optional[str]:
-    """Path of the newest *readable* checkpoint in ``checkpoint_dir``.
-    Unreadable/truncated files (e.g. from a crash mid-write on a filesystem
-    that reordered the rename) are skipped, falling back to the next-newest
-    intact snapshot."""
+    """Path of the newest *readable* checkpoint in ``checkpoint_dir`` —
+    a single-file snapshot, or any piece of a COMPLETE multi-host piece
+    group (load() resolves the siblings).  Unreadable/truncated files
+    (e.g. a crash mid-write) and incomplete groups are skipped, falling
+    back to the next-newest intact snapshot."""
     if not os.path.isdir(checkpoint_dir):
         return None
-    levels = []
+    singles, groups = [], {}
     for name in os.listdir(checkpoint_dir):
+        m = _PIECE_RE.match(name)
+        if m:
+            lvl = int(m.group(1)[len("level_"):])
+            groups.setdefault((lvl, int(m.group(3))), []).append(name)
+            continue
         if name.startswith("level_") and name.endswith(".npz"):
             try:
-                levels.append((int(name[len("level_"):-len(".npz")]), name))
+                singles.append((int(name[len("level_"):-len(".npz")]),
+                                [name]))
             except ValueError:
                 continue
-    for _lvl, name in sorted(levels, reverse=True):
-        path = os.path.join(checkpoint_dir, name)
+    candidates = singles + [(lvl, sorted(names))
+                            for (lvl, nproc), names in groups.items()
+                            if len(names) == nproc]
+    for _lvl, names in sorted(candidates, reverse=True):
         try:
-            with np.load(path) as z:
-                json.loads(bytes(z["meta"]).decode())
-            return path
+            for name in names:       # every piece must be intact
+                with np.load(os.path.join(checkpoint_dir, name)) as z:
+                    json.loads(bytes(z["meta"]).decode())
+            return os.path.join(checkpoint_dir, names[0])
         except Exception:
             continue
     return None

@@ -380,26 +380,39 @@ class MeshBFSEngine:
             sz_b[d] = np.asarray(s.size, np.int32).reshape(1)
         self._CL = new_cl
         self._rebuild_programs()
-        sh = NamedSharding(self.mesh, P("x"))
-        shi2 = jax.make_array_from_callback(
-            (n, new_cl), sh, lambda idx: hi_b[idx[0].start])
-        slo2 = jax.make_array_from_callback(
-            (n, new_cl), sh, lambda idx: lo_b[idx[0].start])
-        ssize2 = jax.make_array_from_callback(
-            (n,), sh, lambda idx: sz_b[idx[0].start])
-        return shi2, slo2, ssize2
+        return self._assemble_sharded_fpset(hi_b, lo_b, sz_b)
 
-    def _stack_sharded(self, shards):
-        """Stack per-chip FPSet shards into (shi, slo, ssize) placed with
-        the mesh sharding — stacking device arrays directly would land
-        the whole n-chip table on one device (see sharded_full)."""
+    def _assemble_sharded_fpset(self, hi_b, lo_b, sz_b):
+        """(shi, slo, ssize) sharded arrays from per-LOCAL-device host
+        shards ({global chip row -> [1, CL] / [1] arrays}); other
+        controllers supply their own rows via the same callbacks."""
+        n, cl = self.n_dev, self._CL
         sh = NamedSharding(self.mesh, P("x"))
-        return (jax.device_put(np.stack([np.asarray(s.hi)
-                                         for s in shards]), sh),
-                jax.device_put(np.stack([np.asarray(s.lo)
-                                         for s in shards]), sh),
-                jax.device_put(np.stack([np.asarray(s.size)
-                                         for s in shards]), sh))
+        return (jax.make_array_from_callback(
+                    (n, cl), sh, lambda idx: hi_b[idx[0].start]),
+                jax.make_array_from_callback(
+                    (n, cl), sh, lambda idx: lo_b[idx[0].start]),
+                jax.make_array_from_callback(
+                    (n,), sh, lambda idx: sz_b[idx[0].start]))
+
+    def _shards_from_keys(self, keys_hi, keys_lo):
+        """Rebuild the sharded FPSet arrays from a global flat key set
+        (owner = fp_hi mod n); each controller materializes only its
+        addressable shards, shard-by-shard (never the whole n-chip table
+        on one device)."""
+        owner = (keys_hi % self.n_dev).astype(np.int64)
+        me = jax.process_index()
+        hi_b, lo_b, sz_b = {}, {}, {}
+        for d in (i for i, dev in enumerate(self.mesh.devices.flat)
+                  if dev.process_index == me):
+            sel = owner == d
+            s = fpset.from_host_keys(keys_hi[sel].astype(np.uint32),
+                                     keys_lo[sel].astype(np.uint32),
+                                     self._CL)
+            hi_b[d] = np.asarray(s.hi)[None]
+            lo_b[d] = np.asarray(s.lo)[None]
+            sz_b[d] = np.asarray(s.size, np.int32).reshape(1)
+        return self._assemble_sharded_fpset(hi_b, lo_b, sz_b)
 
     def _rebuild_programs(self):
         """Re-trace chunk/ingest for a changed seen-shard shape."""
@@ -422,7 +435,24 @@ class MeshBFSEngine:
         dims, cfg = self.dims, self.config
         n, sw, B, QL = self.n_dev, self._sw, self._B, self._QL
         if resume is not None and isinstance(resume, str):
+            resume_path = resume
             resume = ckpt_mod.load(resume)
+            if mh.is_multiprocess():
+                # latest() reads a host-local directory listing, which can
+                # lag on a shared filesystem (NFS attribute caching) — all
+                # controllers must resume the SAME snapshot or the
+                # replicated counters diverge (multihost.py rule 4).  The
+                # oldest level any controller found is the safe agreement.
+                agreed = mh.build_min(self.mesh)(resume.diameter)
+                if agreed != resume.diameter:
+                    import os as _os
+                    d = _os.path.dirname(_os.path.abspath(resume_path))
+                    alt = ckpt_mod.piece_path(d, agreed,
+                                              jax.process_index(),
+                                              jax.process_count())
+                    if not _os.path.exists(alt):
+                        alt = _os.path.join(d, f"level_{agreed:05d}.npz")
+                    resume = ckpt_mod.load(alt)
         if resume is not None and resume.dims != dims:
             raise ValueError(
                 f"checkpoint dims {resume.dims} != engine dims {dims}")
@@ -438,9 +468,6 @@ class MeshBFSEngine:
                 raise NotImplementedError(
                     "multi-host check requires record_trace=False "
                     "(--no-trace): the trace store is per-controller")
-            if cfg.checkpoint_dir is not None or resume is not None:
-                raise NotImplementedError(
-                    "multi-host checkpoint/resume not supported yet")
             if any(c == "queue" for c, _t in cfg.exit_conditions):
                 raise NotImplementedError(
                     'TLCGet("queue") budgets are not multi-host-safe yet '
@@ -555,23 +582,30 @@ class MeshBFSEngine:
 
         if resume is not None:
             # Rebuild shards from the flat key set: owner = fp_hi mod n.
-            keys_hi = np.asarray(resume.seen_hi, np.uint64)
-            keys_lo = np.asarray(resume.seen_lo, np.uint64)
-            owner = (keys_hi % n).astype(np.int64)
-            shards = [fpset.from_host_keys(
-                keys_hi[owner == d].astype(np.uint32),
-                keys_lo[owner == d].astype(np.uint32), self._CL)
-                for d in range(n)]
-            shi, slo, ssize = self._stack_sharded(shards)
+            # Each controller materializes only its addressable shards, so
+            # a checkpoint written by M controllers (piece group, merged
+            # by checkpoint.load) resumes on any process count.
+            shi, slo, ssize = self._shards_from_keys(
+                np.asarray(resume.seen_hi, np.uint64),
+                np.asarray(resume.seen_lo, np.uint64))
             fr = np.ascontiguousarray(resume.frontier).astype(
                 ROW_DTYPE, casting="safe")
-            # Pre-split into upload-sized segments (views): one giant
-            # segment would make the consume loop's remainder re-insert
-            # rewrite the whole tail per upload in disk-backed mode.
-            for i in range(0, len(fr), n * QL):
-                pending.append(fr[i:i + n * QL])
-            cur_counts_dev = zero_counts
             level_rows = len(fr)
+            if mp:
+                # Disjoint frontier slices per controller; the union is
+                # the checkpointed frontier.
+                fr = fr[jax.process_index()::jax.process_count()]
+            # Segment granularity = what one upload can take: this
+            # controller's chips x QL rows (global n*QL single-host) — a
+            # larger pre-split would make the consume loop's remainder
+            # re-insert rewrite the pool head on every upload.
+            seg_cap = QL * sum(
+                1 for d in self.mesh.devices.flat
+                if d.process_index == jax.process_index())
+            # Pre-split into upload-sized segments (views).
+            for i in range(0, len(fr), seg_cap):
+                pending.append(fr[i:i + seg_cap])
+            cur_counts_dev = zero_counts
             res.distinct = resume.distinct
             res.generated = resume.generated
             res.diameter = resume.diameter
@@ -668,13 +702,19 @@ class MeshBFSEngine:
                 and res.violation is None and res.stop_reason == "exhausted":
             if cfg.checkpoint_dir is not None \
                     and res.diameter % max(1, cfg.checkpoint_every) == 0 \
-                    and res.diameter != skip_ckpt_level \
-                    and (time.time() - last_ckpt
-                         >= cfg.checkpoint_interval_seconds):
-                self._write_checkpoint(qcur, cur_counts_dev, pending, shi,
-                                       slo, res, trace,
-                                       wall=time.time() - t0)
-                last_ckpt = time.time()
+                    and res.diameter != skip_ckpt_level:
+                want_ckpt = (time.time() - last_ckpt
+                             >= cfg.checkpoint_interval_seconds)
+                if any_flag is not None:
+                    # Interval clocks differ per host; a piece group is
+                    # only resumable when EVERY controller wrote its piece
+                    # — agree, so groups are always complete.
+                    want_ckpt = any_flag(want_ckpt)
+                if want_ckpt:
+                    self._write_checkpoint(qcur, cur_counts_dev, pending,
+                                           shi, slo, res, trace,
+                                           wall=time.time() - t0)
+                    last_ckpt = time.time()
             if cfg.max_diameter is not None \
                     and res.diameter >= cfg.max_diameter:
                 res.stop_reason = "diameter_budget"
@@ -930,14 +970,22 @@ class MeshBFSEngine:
             tp = np.empty(0, np.uint64)
             ta = np.empty(0, np.int32)
             roots = {}
+        # This controller's share only: its pool + device shards + seen
+        # shards.  Multi-host writes one piece per controller (identical
+        # replicated counters in each); checkpoint.load merges the group.
         frontier, front_cleanup = pending.concat_with(
             self._drain(qcur, self._local_counts(cur_counts)))
-        hi_h, lo_h = np.asarray(shi), np.asarray(slo)
         keys_hi, keys_lo = [], []
-        for d in range(self.n_dev):
-            real = ~((hi_h[d] == SENTINEL) & (lo_h[d] == SENTINEL))
-            keys_hi.append(hi_h[d][real])
-            keys_lo.append(lo_h[d][real])
+        for s_hi, s_lo in zip(
+                sorted(shi.addressable_shards,
+                       key=lambda s: s.index[0].start),
+                sorted(slo.addressable_shards,
+                       key=lambda s: s.index[0].start)):
+            hi_h = np.asarray(s_hi.data)[0]
+            lo_h = np.asarray(s_lo.data)[0]
+            real = ~((hi_h == SENTINEL) & (lo_h == SENTINEL))
+            keys_hi.append(hi_h[real])
+            keys_lo.append(lo_h[real])
         keys_hi = np.concatenate(keys_hi) if keys_hi else np.empty(0)
         keys_lo = np.concatenate(keys_lo) if keys_lo else np.empty(0)
         order = np.lexsort((keys_lo, keys_hi))
@@ -950,9 +998,15 @@ class MeshBFSEngine:
             action_counts=dict(res.action_counts),
             wall_seconds=wall,
             trace_fps=tf, trace_parents=tp, trace_actions=ta, roots=roots)
+        if jax.process_count() > 1:
+            path = ckpt_mod.piece_path(self.config.checkpoint_dir,
+                                       res.diameter, jax.process_index(),
+                                       jax.process_count())
+        else:
+            path = os.path.join(self.config.checkpoint_dir,
+                                f"level_{res.diameter:05d}.npz")
         try:
-            ckpt_mod.save(os.path.join(self.config.checkpoint_dir,
-                                       f"level_{res.diameter:05d}.npz"), ck)
+            ckpt_mod.save(path, ck)
         finally:
             front_cleanup()
 

@@ -19,7 +19,8 @@ neutralize_axon_if_cpu_requested()
 
 from raft_tla_tpu.parallel import multihost as mh  # noqa: E402
 
-mh.initialize()
+if os.environ.get("RAFT_COORDINATOR"):
+    mh.initialize()    # single-controller mode otherwise (resume test b)
 
 import jax  # noqa: E402
 
@@ -33,6 +34,8 @@ from raft_tla_tpu.parallel.mesh import MeshBFSEngine  # noqa: E402
 
 def main():
     dims = RaftDims(n_servers=2, n_values=1, max_log=2, n_msg_slots=8)
+    ckpt_dir = os.environ.get("MH_CKPT_DIR")
+    max_dia = os.environ.get("MH_MAX_DIAMETER")
     eng = MeshBFSEngine(
         dims,
         invariants={"TypeOK": build_type_ok(dims)},
@@ -41,9 +44,17 @@ def main():
                          max_in_flight=1)),
         config=EngineConfig(batch=32, queue_capacity=1 << 10,
                             seen_capacity=1 << 14, check_deadlock=False,
-                            record_trace=False, sync_every=4))
+                            record_trace=False, sync_every=4,
+                            checkpoint_dir=ckpt_dir,
+                            max_diameter=int(max_dia) if max_dia else None))
     assert eng.n_dev == len(jax.devices())    # the GLOBAL mesh
-    res = eng.run([init_state(dims)])
+    if os.environ.get("MH_RESUME"):
+        from raft_tla_tpu.engine import checkpoint as ckpt_mod
+        path = ckpt_mod.latest(os.environ["MH_RESUME"])
+        assert path is not None, "no resumable checkpoint found"
+        res = eng.run(None, resume=path)
+    else:
+        res = eng.run([init_state(dims)])
     print(json.dumps({
         "process": jax.process_index(),
         "global_devices": len(jax.devices()),

@@ -33,9 +33,9 @@ def _spawn(pid, nproc, port, script="mh_sim_worker.py", extra_env=None):
         text=True)
 
 
-def _run_pair(script, timeout=900):
+def _run_pair(script, timeout=900, extra_env=None):
     port = _free_port()
-    procs = [_spawn(i, 2, port, script) for i in range(2)]
+    procs = [_spawn(i, 2, port, script, extra_env) for i in range(2)]
     outs = []
     for p in procs:
         try:
@@ -105,3 +105,44 @@ def test_two_process_exhaustive_bfs_matches_oracle():
     assert a["distinct"] == 4779
     assert a["diameter"] == 25
     assert a["generated"] == 12584
+
+
+def test_multihost_checkpoint_resumes_everywhere(tmp_path):
+    """Checkpoint portability across controller counts: two controllers
+    write a piece group mid-run; (a) two controllers resume it to
+    exhaustion, (b) ONE controller (plain single-host engine path) resumes
+    the same group — both must land on the oracle-pinned totals."""
+    ck = str(tmp_path / "ck")
+    a, b = _run_pair("mh_bfs_worker.py", extra_env={
+        "MH_CKPT_DIR": ck, "MH_MAX_DIAMETER": "12"})
+    assert a["stop_reason"] == b["stop_reason"] == "diameter_budget"
+    import glob
+    pieces = sorted(glob.glob(ck + "/*.p*of2.npz"))
+    assert len(pieces) >= 2          # a complete group per written level
+
+    # (a) two-controller resume to exhaustion.
+    a2, b2 = _run_pair("mh_bfs_worker.py", extra_env={"MH_RESUME": ck})
+    for k in ("distinct", "generated", "diameter", "levels", "stop_reason"):
+        assert a2[k] == b2[k], (k, a2, b2)
+    assert a2["stop_reason"] == "exhausted"
+    assert a2["distinct"] == 4779 and a2["diameter"] == 25
+    assert a2["generated"] == 12584
+
+    # (b) single-controller resume of the piece group (merged by
+    # checkpoint.load): same totals.
+    import os as _os
+    import subprocess as _sp
+    import sys as _sys
+    env = dict(_os.environ, JAX_PLATFORMS="cpu",
+               XLA_FLAGS="--xla_force_host_platform_device_count=4",
+               MH_RESUME=ck)
+    env.pop("RAFT_COORDINATOR", None)
+    p = _sp.run([_sys.executable,
+                 _os.path.join(REPO, "tests", "mh_bfs_worker.py")],
+                env=env, cwd=REPO, capture_output=True, text=True,
+                timeout=600)
+    assert p.returncode == 0, p.stderr[-3000:]
+    r = json.loads(p.stdout.strip().splitlines()[-1])
+    assert r["stop_reason"] == "exhausted"
+    assert r["distinct"] == 4779 and r["diameter"] == 25
+    assert r["generated"] == 12584
