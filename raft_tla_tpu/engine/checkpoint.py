@@ -118,6 +118,19 @@ def _merge(pieces) -> Checkpoint:
     for p in pieces[1:]:
         if p.dims != base.dims:
             raise ValueError("checkpoint pieces disagree on dims")
+        # The counters are psum-replicated at write time, so every piece
+        # of one generation carries identical metadata.  A mismatch means
+        # the group mixes pieces from different run generations (a crash
+        # between piece overwrites) — merging would silently produce a
+        # frontier/seen-set belonging to neither run.
+        if (p.distinct, p.generated, p.diameter, p.levels) != \
+                (base.distinct, base.generated, base.diameter,
+                 base.levels):
+            raise ValueError(
+                "checkpoint piece group mixes run generations "
+                f"(counters disagree: {p.diameter}/{p.distinct} vs "
+                f"{base.diameter}/{base.distinct}); delete the stale "
+                "pieces or resume an older complete snapshot")
     hi = np.concatenate([p.seen_hi for p in pieces])
     lo = np.concatenate([p.seen_lo for p in pieces])
     order = np.lexsort((lo, hi))

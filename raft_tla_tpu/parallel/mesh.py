@@ -445,13 +445,16 @@ class MeshBFSEngine:
                 # oldest level any controller found is the safe agreement.
                 agreed = mh.build_min(self.mesh)(resume.diameter)
                 if agreed != resume.diameter:
+                    import glob as _glob
                     import os as _os
                     d = _os.path.dirname(_os.path.abspath(resume_path))
-                    alt = ckpt_mod.piece_path(d, agreed,
-                                              jax.process_index(),
-                                              jax.process_count())
-                    if not _os.path.exists(alt):
-                        alt = _os.path.join(d, f"level_{agreed:05d}.npz")
+                    # The agreed level's snapshot may be a piece group
+                    # from ANY writer count (load() resolves siblings
+                    # from any one piece) or a single file.
+                    cands = sorted(_glob.glob(_os.path.join(
+                        d, f"level_{agreed:05d}.p0of*.npz")))
+                    alt = cands[0] if cands else _os.path.join(
+                        d, f"level_{agreed:05d}.npz")
                     resume = ckpt_mod.load(alt)
         if resume is not None and resume.dims != dims:
             raise ValueError(
@@ -468,14 +471,16 @@ class MeshBFSEngine:
                 raise NotImplementedError(
                     "multi-host check requires record_trace=False "
                     "(--no-trace): the trace store is per-controller")
-            if any(c == "queue" for c, _t in cfg.exit_conditions):
-                raise NotImplementedError(
-                    'TLCGet("queue") budgets are not multi-host-safe yet '
-                    "(the spill pools are per-controller)")
         # Collective agreement on host-local facts (clocks); identical-
         # everywhere decisions skip the round trip (multihost.py rule 4).
         any_flag = mh.build_any(self.mesh) if mp else None
         budget_agree = mh.build_budget_agree(self.mesh) if mp else None
+        # TLCGet("queue") consults the per-controller pools; under a
+        # process group the totals are psum-agreed (one extra round trip
+        # per check — only paid when a queue budget is actually set).
+        has_queue_budget = any(c == "queue" for c, _t in cfg.exit_conditions)
+        pool_sum = (mh.build_sum(self.mesh)
+                    if mp and has_queue_budget else None)
         res = EngineResult()
         self._growth_stalls = res.growth_stalls
         t_enter = time.time()
@@ -647,10 +652,14 @@ class MeshBFSEngine:
                         break
                 if c and cfg.exit_conditions:
                     # "queue" during ingest: enqueued + landed spills +
-                    # roots not yet ingested (engine/bfs.py rationale).
+                    # roots not yet ingested (engine/bfs.py rationale);
+                    # pool rows psum-agreed under a process group.
+                    pools = spill_next.total_rows()
+                    if pool_sum is not None:
+                        pools = pool_sum(pools)
                     hit = _exit_condition_hit(
                         cfg.exit_conditions, res,
-                        cur_sum + spill_next.total_rows()
+                        cur_sum + pools
                         + sum(max(0, len(p) - c * B) for p in per_chip))
                     if hit:
                         res.stop_reason = hit
@@ -837,12 +846,16 @@ class MeshBFSEngine:
                     if cfg.exit_conditions or want_progress:
                         # "queue" counts the FULL unexplored queue: this
                         # level's remainder (replicated psum) + next-level
-                        # rows + landed and in-flight spill segments
-                        # (this controller's pools; global single-host).
-                        queue_rows = (
-                            int(st[9]) + pending.total_rows()
-                            + cur_sum + spill_next.total_rows()
+                        # rows + landed and in-flight spill segments.
+                        # Pool rows are per-controller; psum-agree them
+                        # when a queue budget needs the global total.
+                        local_pools = (
+                            pending.total_rows() + spill_next.total_rows()
                             + sum(sum(c.values()) for _b, c in inflight))
+                        if pool_sum is not None:
+                            local_pools = pool_sum(local_pools)
+                        queue_rows = (
+                            int(st[9]) + cur_sum + local_pools)
                         if want_progress:
                             _progress_line(res, t0, queue_rows, int(st[14]))
                             last_progress = time.time()

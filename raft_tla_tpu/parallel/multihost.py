@@ -124,6 +124,35 @@ def build_min(mesh: Mesh):
     return min_val
 
 
+def build_sum(mesh: Mesh):
+    """Agreement primitive summing per-PROCESS ints (each process's value
+    counted ONCE, not once per device: only the process's first device
+    row carries it) — e.g. totalling the per-controller spill-pool rows
+    for a global queue size."""
+    fn = _build_agree(mesh, jax.lax.psum)
+    n = mesh.devices.size
+    me = jax.process_index()
+    first = min((i for i, d in enumerate(mesh.devices.flat)
+                 if d.process_index == me), default=0)
+
+    # The device agreement runs in int32 (JAX x64 is off) and pool row
+    # counts at the spill design scale can exceed it: saturate each
+    # process's contribution so the device-side sum cannot wrap.  A
+    # saturated total still trips every budget below ~2^31/N rows — it
+    # can only over-report, never under-report.
+    cap = ((1 << 31) - 1) // max(1, jax.process_count())
+
+    def sum_val(value: int) -> int:
+        local = np.zeros((n,), np.int32)
+        local[first] = min(int(value), cap)
+        arr = jax.make_array_from_callback(
+            (n,), NamedSharding(mesh, P("x")),
+            lambda idx: local[idx[0].start:idx[0].stop])
+        return int(np.asarray(fn(arr)))
+
+    return sum_val
+
+
 def build_budget_agree(mesh: Mesh):
     """Fused per-chunk budget agreement — ONE cross-host round trip for
     the pair every budgeted chunk needs: (any process over deadline?,

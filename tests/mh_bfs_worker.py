@@ -46,7 +46,12 @@ def main():
                             seen_capacity=1 << 14, check_deadlock=False,
                             record_trace=False, sync_every=4,
                             checkpoint_dir=ckpt_dir,
-                            max_diameter=int(max_dia) if max_dia else None))
+                            max_diameter=int(max_dia) if max_dia else None,
+                            exit_conditions=(
+                                (("queue",
+                                  float(os.environ["MH_QUEUE_BUDGET"])),)
+                                if os.environ.get("MH_QUEUE_BUDGET")
+                                else ())))
     assert eng.n_dev == len(jax.devices())    # the GLOBAL mesh
     if os.environ.get("MH_RESUME"):
         from raft_tla_tpu.engine import checkpoint as ckpt_mod

@@ -146,3 +146,15 @@ def test_multihost_checkpoint_resumes_everywhere(tmp_path):
     assert r["stop_reason"] == "exhausted"
     assert r["distinct"] == 4779 and r["diameter"] == 25
     assert r["generated"] == 12584
+
+
+def test_multihost_queue_budget_agrees(tmp_path):
+    """TLCGet("queue") under a process group: the per-controller pool
+    totals are psum-agreed, so both controllers stop at the same chunk
+    with the same counters."""
+    a, b = _run_pair("mh_bfs_worker.py",
+                     extra_env={"MH_QUEUE_BUDGET": "150"})
+    for k in ("distinct", "generated", "diameter", "stop_reason"):
+        assert a[k] == b[k], (k, a, b)
+    assert a["stop_reason"] == "queue_budget"
+    assert a["distinct"] < 4779      # stopped well before exhaustion
