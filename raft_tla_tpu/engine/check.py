@@ -117,6 +117,54 @@ def initial_states(setup: CheckSetup, seed: int = 0) -> List[PyState]:
     return [init_state(setup.dims)]
 
 
+def path_to_state(dims: RaftDims, target: PyState,
+                  constraint: Optional[Callable] = None,
+                  init_states: Optional[List[PyState]] = None,
+                  config: Optional[EngineConfig] = None):
+    """Minimal action path from Init to ``target`` — the counterexample
+    extractor for runs that had no trace store (multi-host runs record no
+    traces; their Violation still carries the concrete state).  Runs a
+    single-host BFS with an injected "never reaches target" invariant and
+    replays the hit: BFS order makes the result a minimal-depth path.
+
+    Returns ``[(grid_index, PyState), ...]`` (root first, grid_index -1
+    for the root) — pretty-print actions with ``dims.describe_instance``.
+    Raises if ``target`` is unreachable inside the constraint bounds."""
+    import dataclasses as _dc
+
+    import jax.numpy as jnp
+
+    from ..models.schema import encode_state
+    from ..ops.fingerprint import build_fingerprint
+    fingerprint = build_fingerprint(dims)
+    thi, tlo = (int(x) for x in fingerprint(encode_state(target, dims)))
+
+    roots = init_states or [init_state(dims)]
+    if target in roots:
+        return [(-1, target)]           # trivial path: target IS a root
+
+    def not_target(st):
+        h, l = fingerprint(st)
+        return ~((h == jnp.uint32(thi)) & (l == jnp.uint32(tlo)))
+
+    # The extractor needs its own trace store regardless of how the
+    # original (possibly trace-less multi-host) run was configured, and
+    # only cares about reachability — a reachable dead-end state at a
+    # shallower level must not abort the search.
+    cfg = _dc.replace(config or EngineConfig(),
+                      record_trace=True, check_deadlock=False)
+    eng = BFSEngine(dims, invariants={"__NotTarget": not_target},
+                    constraint=constraint, config=cfg)
+    res = eng.run(roots)
+    if res.violation is None:
+        raise ValueError(
+            f"target state unreachable within the explored space "
+            f"({res.distinct} states, stop: {res.stop_reason})")
+    assert res.violation.state == target, \
+        "fingerprint collision: matched state differs from target"
+    return eng.replay(res.violation.fingerprint)
+
+
 def run_check(cfg_path: str, engine_config: Optional[EngineConfig] = None,
               seed: int = 0, max_log: Optional[int] = None,
               n_msg_slots: Optional[int] = None) -> EngineResult:

@@ -470,7 +470,11 @@ class MeshBFSEngine:
             if cfg.record_trace:
                 raise NotImplementedError(
                     "multi-host check requires record_trace=False "
-                    "(--no-trace): the trace store is per-controller")
+                    "(--no-trace): the trace store is per-controller.  "
+                    "To extract a counterexample from a multi-host "
+                    "violation, pass its .state to "
+                    "engine.check.path_to_state on one host — BFS order "
+                    "makes the result a minimal-depth trace")
         # Collective agreement on host-local facts (clocks); identical-
         # everywhere decisions skip the round trip (multihost.py rule 4).
         any_flag = mh.build_any(self.mesh) if mp else None

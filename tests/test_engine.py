@@ -500,3 +500,43 @@ def test_progress_lines_emitted(capfd):
                       config=small_config(max_diameter=3))
     quiet.run([init_state(DIMS)])
     assert "progress:" not in capfd.readouterr().err
+
+
+def test_path_to_state_recovers_minimal_counterexample():
+    """path_to_state extracts a minimal action path to any concrete state
+    — the counterexample route for trace-less (e.g. multi-host) runs,
+    which report the violating state but record no trace."""
+    from raft_tla_tpu.engine.check import path_to_state
+    want = orc.bfs([init_state(DIMS)], DIMS,
+                   constraint=constraint_py(BOUNDS),
+                   check_deadlock=False, max_levels=4)
+    # Deepest layer: a state whose minimal depth is exactly 4.
+    target = next(s for s in want.parent
+                  if len(want.trace_to(s)) - 1 == 4)
+    steps = path_to_state(
+        DIMS, target, constraint=build_constraint(DIMS, BOUNDS),
+        config=small_config(record_trace=True))
+    assert steps[-1][1] == target
+    assert len(steps) - 1 == 4          # minimal depth (BFS order)
+    for (s_prev, s_next) in zip(steps, steps[1:]):
+        assert s_next[1] in orc.successor_set(s_prev[1], DIMS)
+
+
+def test_path_to_state_edge_cases():
+    """Robustness of the extractor contract: a trace-less caller config
+    must not break replay, a root target yields the trivial path, and
+    deadlock states on shallower levels must not abort the search."""
+    from raft_tla_tpu.engine.check import path_to_state
+    # Root target: trivial path, no BFS.
+    assert path_to_state(DIMS, init_state(DIMS)) == [(-1, init_state(DIMS))]
+    # A config with record_trace=False and deadlock checking on (the
+    # multi-host run shape) is overridden internally.
+    want = orc.bfs([init_state(DIMS)], DIMS,
+                   constraint=constraint_py(BOUNDS),
+                   check_deadlock=False, max_levels=2)
+    target = next(s for s in want.parent
+                  if len(want.trace_to(s)) - 1 == 2)
+    steps = path_to_state(
+        DIMS, target, constraint=build_constraint(DIMS, BOUNDS),
+        config=small_config(record_trace=False, check_deadlock=True))
+    assert steps[-1][1] == target and len(steps) - 1 == 2
