@@ -42,6 +42,12 @@ if ! probe; then
 fi
 echo "tpu ok"
 
+# Single-core host: a background CPU measurement (e.g. the configs[3]
+# simulation sweep) would starve XLA compilation for every stage below —
+# the TPU session takes priority the moment the tunnel answers.
+pkill -f "num-steps 100000000" 2>/dev/null && \
+    echo "(killed background CPU simulation sweep; TPU session takes priority)"
+
 echo "== 2. profile_step (B=2048) =="
 timeout 1200 python scripts/profile_step.py 2048 \
     2> artifacts/profile_step_tpu.log | tee artifacts/profile_step_tpu.txt
