@@ -45,7 +45,7 @@ echo "tpu ok"
 # Single-core host: a background CPU measurement (e.g. the configs[3]
 # simulation sweep) would starve XLA compilation for every stage below —
 # the TPU session takes priority the moment the tunnel answers.
-pkill -f "num-steps 100000000" 2>/dev/null && \
+pkill -f "raft_tla_tpu simulate.*platform cpu" 2>/dev/null && \
     echo "(killed background CPU simulation sweep; TPU session takes priority)"
 
 echo "== 2. profile_step (B=2048) =="

@@ -103,16 +103,7 @@ from raft_tla_tpu.models.dims import (A_RECEIVE, AEQ, AER, RVQ, RVR,
 
 def receive_successors_both(expand, s):
     """(kernel, oracle) successor lists restricted to the Receive family."""
-    st = encode_state(s, DIMS)
-    cands, enabled, overflow = jax.device_get(expand(st))
-    assert not overflow.any()
-    kout = []
-    for g in range(DIMS.n_instances):
-        if enabled[g] and DIMS.instance_info(g)[0] == A_RECEIVE:
-            row = jax.tree.map(lambda a: a[g], cands)
-            kout.append(decode_state(StateBatch(*row), DIMS))
-    oout = [t for (fam, _p), t in orc.successors(s, DIMS) if fam == A_RECEIVE]
-    return kout, oout
+    return family_successors_both(expand, s, A_RECEIVE)
 
 
 def assert_golden(expand, s, expected):
@@ -301,3 +292,134 @@ def test_golden_ae_response_updates_cursors(expand):
     s3 = s.replace(next_index=((1, 1, 1), (1, 1, 1), (1, 1, 1)),
                    messages=bag(fail))
     assert_golden(expand, s3, [s3.replace(messages=frozenset())])
+
+
+# --- spontaneous-family golden vectors (same method: derived from the
+# spec TEXT, asserted against both implementations) ---------------------
+
+from raft_tla_tpu.models.dims import (A_ADVANCECOMMIT, A_APPENDENTRIES,
+                                      A_BECOMELEADER, A_RESTART, A_TIMEOUT)
+
+
+def family_successors_both(expand, s, fam):
+    """(kernel, oracle) successor lists restricted to one action family."""
+    st = encode_state(s, DIMS)
+    cands, enabled, overflow = jax.device_get(expand(st))
+    assert not overflow.any()
+    kout = []
+    for g in range(DIMS.n_instances):
+        if enabled[g] and DIMS.instance_info(g)[0] == fam:
+            row = jax.tree.map(lambda a: a[g], cands)
+            kout.append(decode_state(StateBatch(*row), DIMS))
+    oout = [t for (f, _p), t in orc.successors(s, DIMS) if f == fam]
+    return kout, oout
+
+
+def assert_family_golden(expand, s, fam, expected):
+    kout, oout = family_successors_both(expand, s, fam)
+    assert sorted(oout, key=hash) == sorted(expected, key=hash), \
+        f"oracle disagrees with spec text\n{s}"
+    assert sorted(kout, key=hash) == sorted(expected, key=hash), \
+        f"kernel disagrees with spec text\n{s}"
+
+
+def test_golden_advance_commit_current_term(expand):
+    """raft.tla:219-236: Agree(index) = {i} + servers with matchIndex >=
+    index; commit Max(agreeIndexes) only when THAT entry's term equals
+    currentTerm (the Raft §5.4.2 rule, :229-230)."""
+    s = init_state(DIMS).replace(
+        current_term=(3, 3, 3), role=(LEADER, 0, 0),
+        log=(((2, 1), (3, 1)), (), ()),
+        match_index=((0, 2, 0), (0, 0, 0), (0, 0, 0)))
+    assert_family_golden(expand, s, A_ADVANCECOMMIT,
+                         [s.replace(commit_index=(2, 0, 0))])
+
+
+def test_golden_advance_commit_blocked_by_old_term(expand):
+    """raft.tla:228-233: the term check applies to Max(agreeIndexes) ONLY
+    — here entry 1 has the current term and quorum agreement, but entry 2
+    (the max agreed index) is old-term, so newCommitIndex falls back to
+    the UNCHANGED commitIndex and the action self-loops (a generated
+    successor equal to the source state)."""
+    s = init_state(DIMS).replace(
+        current_term=(3, 3, 3), role=(LEADER, 0, 0),
+        log=(((3, 1), (2, 2)), (), ()),
+        match_index=((0, 2, 0), (0, 0, 0), (0, 0, 0)))
+    assert_family_golden(expand, s, A_ADVANCECOMMIT, [s])
+
+
+def test_golden_append_entries_payload(expand):
+    """raft.tla:171-192: prevLogTerm via the guarded lookup (:177-180),
+    entries = SubSeq(log, nextIndex, Min({Len, nextIndex})) — at most ONE
+    entry (:181-183) — and mcommitIndex = Min({commitIndex, lastEntry})
+    (:189), NOT the raw commitIndex."""
+    base = init_state(DIMS).replace(
+        current_term=(3, 3, 3), role=(LEADER, 0, 0),
+        log=(((2, 1), (3, 2)), (), ()), commit_index=(2, 0, 0))
+
+    # nextIndex[0][1]=2: prev=(1, term 2), entries=<<log[2]>>, mcommit=2.
+    s = base.replace(next_index=((1, 2, 1), (1, 1, 1), (1, 1, 1)))
+    k, o = family_successors_both(expand, s, A_APPENDENTRIES)
+    want = s.replace(messages=bag((2, 0, 1, 3, 1, 2, ((3, 2),), 2)))
+    assert want in o and want in k
+
+    # nextIndex[0][1]=3 (past the end): empty entries heartbeat with
+    # prevLogTerm from the guarded lookup (prev=2 <= Len).
+    s2 = base.replace(next_index=((1, 3, 1), (1, 1, 1), (1, 1, 1)))
+    k2, o2 = family_successors_both(expand, s2, A_APPENDENTRIES)
+    want2 = s2.replace(messages=bag((2, 0, 1, 3, 2, 3, (), 2)))
+    assert want2 in o2 and want2 in k2
+
+    # nextIndex[0][1]=1: prevLogIndex=0 -> prevLogTerm=0 (:178-180), and
+    # mcommitIndex = Min({2, lastEntry=1}) = 1 — the Min clamp observable.
+    s3 = base.replace(next_index=((1, 1, 1), (1, 1, 1), (1, 1, 1)))
+    k3, o3 = family_successors_both(expand, s3, A_APPENDENTRIES)
+    want3 = s3.replace(messages=bag((2, 0, 1, 3, 0, 0, ((2, 1),), 1)))
+    assert want3 in o3 and want3 in k3
+
+
+def test_golden_timeout_does_not_self_vote(expand):
+    """raft.tla:146-154: ->Candidate, term+1, votedFor -> Nil (the spec
+    deliberately does NOT self-vote, comment :149-150), vote sets
+    cleared; logVars and leaderVars untouched."""
+    s = init_state(DIMS).replace(voted_for=(3, 0, 0),
+                                 votes_granted=(0b111, 0, 0),
+                                 votes_responded=(0b111, 0, 0))
+    want = s.replace(role=(1, 0, 0), current_term=(2, 1, 1),
+                     voted_for=(0, 0, 0), votes_granted=(0, 0, 0),
+                     votes_responded=(0, 0, 0))
+    kout, oout = family_successors_both(expand, s, A_TIMEOUT)
+    assert want in kout and want in oout
+
+
+def test_golden_restart_keeps_stable_storage(expand):
+    """raft.tla:136-143: Restart preserves currentTerm/votedFor/log (the
+    stable storage) but resets role, vote sets, cursors, AND commitIndex
+    to 0 — the volatile state."""
+    s = init_state(DIMS).replace(
+        current_term=(3, 2, 2), role=(LEADER, 0, 0),
+        voted_for=(1, 1, 1), log=(((2, 1), (3, 2)), (), ()),
+        commit_index=(2, 0, 0), votes_granted=(0b011, 0, 0),
+        votes_responded=(0b111, 0, 0),
+        next_index=((3, 3, 3), (1, 1, 1), (1, 1, 1)),
+        match_index=((0, 2, 0), (0, 0, 0), (0, 0, 0)))
+    want = s.replace(role=(0, 0, 0), votes_granted=(0, 0, 0),
+                     votes_responded=(0, 0, 0), commit_index=(0, 0, 0),
+                     next_index=((1, 1, 1), (1, 1, 1), (1, 1, 1)),
+                     match_index=((0, 0, 0), (0, 0, 0), (0, 0, 0)))
+    kout, oout = family_successors_both(expand, s, A_RESTART)
+    assert want in kout and want in oout
+
+
+def test_golden_become_leader_cursor_init(expand):
+    """raft.tla:195-203: quorum of granted votes -> Leader with
+    nextIndex[j] = Len(log)+1 for EVERY j (self included) and
+    matchIndex[j] = 0; term/votedFor/log untouched."""
+    s = init_state(DIMS).replace(
+        current_term=(2, 2, 2), role=(1, 0, 0), voted_for=(1, 1, 1),
+        log=(((2, 1),), (), ()), votes_granted=(0b011, 0, 0),
+        votes_responded=(0b011, 0, 0))
+    want = s.replace(role=(LEADER, 0, 0),
+                     next_index=((2, 2, 2), (1, 1, 1), (1, 1, 1)),
+                     match_index=((0, 0, 0), (0, 0, 0), (0, 0, 0)))
+    assert_family_golden(expand, s, A_BECOMELEADER, [want])
