@@ -180,6 +180,10 @@ def _do_check(req):
            "levels": list(res.levels), "stop_reason": res.stop_reason,
            "wall_seconds": round(res.wall_seconds, 3),
            "batch": engine.config.batch,      # resolved, for observability
+           "action_counts": dict(res.action_counts),
+           # (capacity-after, off-clock stall seconds) per seen-set
+           # doubling — the SEEN_CAPACITY sizing evidence.
+           "growth_stalls": list(res.growth_stalls),
            "violation": None, "deadlock": None}
     if res.violation is not None:
         out["violation"] = _violation_json(engine, res.violation,
