@@ -35,7 +35,11 @@ from ..models.pystate import PyState
 # v2: frontier rows are packed uint8 (v1 stored int32 rows with no value
 # bounds; loading them into the packed engine could wrap silently, so v1
 # files are rejected rather than converted).
-FORMAT_VERSION = 2
+# v3: the fingerprint function changed (ops/fingerprint.py hardening,
+# 2026-07-31) — v2 snapshots' seen-keys and trace fingerprints are keyed
+# by the old hash; resuming them would re-count explored states as new,
+# so they are rejected rather than silently mis-resumed.
+FORMAT_VERSION = 3
 
 
 @dataclasses.dataclass

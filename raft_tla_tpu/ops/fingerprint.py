@@ -7,19 +7,35 @@ instead of one emulated u64 (TPUs have no native 64-bit integers; everything
 here stays in uint32 on the VPU):
 
 - the *ordered* part of the state (all server-indexed tensors; order is
-  semantic, there is no symmetry reduction) is hashed with a multilinear
-  pass: ``sum(x * C_lane) mod 2^32`` with fixed random odd constants —
-  an almost-universal family;
+  semantic, there is no symmetry reduction) is hashed element-wise with
+  ``sum(fmix32(x * C_lane + seed)) mod 2^32`` — each position's
+  contribution goes through a full avalanche BEFORE the sum, so a
+  difference in two positions cannot cancel linearly;
 - the *message bag* (raft.tla:31) must hash order-invariantly in slot
-  order, so each occupied slot row is mixed to a per-message hash and the
-  bag contributes ``sum(mix(row) * count)`` — the standard commutative
-  multiset hash.  Equal bags give equal sums regardless of slot layout,
-  and multiplicities are respected without any sorting pass;
-- lane values are finalized with the murmur3 fmix32 avalanche.
+  order, so each occupied slot row is double-mixed to a per-message hash
+  and the bag contributes ``sum(mix(row) * count)`` — the standard
+  commutative multiset hash.  Equal bags give equal sums regardless of
+  slot layout, and multiplicities are respected without any sorting pass;
+- the bag sum is avalanched again before combining with the ordered part,
+  and lane values are finalized with the murmur3 fmix32 avalanche.
 
-Two independent lanes give an effective ~2^-64 pairwise collision rate,
-matching TLC's regime.  The pair (hi, lo) is also the key layout the
-sorted fingerprint set (ops/fpset.py) sorts on with a two-key lexsort.
+Two independent lanes target TLC's ~2^-64 pairwise regime.  The pair
+(hi, lo) is also the key layout the sorted fingerprint set (ops/fpset.py)
+sorts on with a two-key lexsort.
+
+Hardening history (2026-07-31): the original design summed RAW products
+(``sum(x*C)``, multilinear) and combined the bag sum linearly — a family
+where structured state differences can cancel linearly, so it was
+replaced with the per-element avalanche above as a matter of hygiene.
+Measurement note: a 63M-state engine run (MCraft_bounded level 13) found
+63,312,389 distinct vs the oracle's 63,312,437 — a 48-state deficit that
+is IDENTICAL under both hash designs (artifacts/mcraft_L13_engine.txt
+and _v2.txt), which RULES OUT fingerprint collisions as its cause (two
+independent hash families cannot collide on the same 48 pairs).  Every
+level <= 12 and the full generated count (186,182,136) match the oracle
+exactly; the deficit is deterministic and hash-independent — a
+representational question (canonical-encoding alias or a rare
+candidate-path edge) tracked as the top open item in ROUND4_NOTES.md.
 
 The all-ones pair is reserved as the FPSet's empty/pad sentinel; real
 fingerprints landing on it are remapped deterministically.
@@ -78,15 +94,20 @@ def build_fingerprint(dims: RaftDims):
 
     def lane_hash(st, flat, lane):
         c_ord, c_msg, seed = consts[lane]
-        base = jnp.sum(flat * c_ord, dtype=_U32)
+        # Avalanche each position BEFORE summing: a multilinear sum is a
+        # family where structured differences CAN cancel linearly across
+        # lanes — hardened as hygiene; note the measured L13 deficit was
+        # proven NOT to be hash collisions (module docstring).
+        base = jnp.sum(fmix32(flat * c_ord + seed), dtype=_U32)
         rows = st.msg.view(_U32) if st.msg.dtype != jnp.uint32 else st.msg
-        slot_h = fmix32(jnp.sum(rows * c_msg[None, :], axis=1,
-                                dtype=_U32) ^ seed)               # [M]
+        slot_h = fmix32(fmix32(jnp.sum(rows * c_msg[None, :], axis=1,
+                                       dtype=_U32) ^ seed)
+                        * _U32(0x85EBCA6B) + seed)                # [M]
         occupied = st.msg_cnt > 0
         msum = jnp.sum(jnp.where(occupied, slot_h
                                  * st.msg_cnt.astype(_U32), _U32(0)),
                        dtype=_U32)
-        return fmix32(base + msum * _U32(0x9E3779B9) + seed)
+        return fmix32(base + fmix32(msum + seed) * _U32(0x9E3779B9))
 
     def fingerprint(st: StateBatch):
         flat = _flat_ordered(st)
