@@ -423,3 +423,40 @@ def test_golden_become_leader_cursor_init(expand):
                      next_index=((2, 2, 2), (1, 1, 1), (1, 1, 1)),
                      match_index=((0, 0, 0), (0, 0, 0), (0, 0, 0)))
     assert_family_golden(expand, s, A_BECOMELEADER, [want])
+
+
+def test_kernel_rows_fingerprint_canonically(expand):
+    """Every candidate row the kernel emits must fingerprint identically
+    to the canonical re-encoding of its decoded state — i.e., kernel
+    successor rows carry no semantic-field deviation from the canonical
+    encoding (slot ORDER may differ; the bag hash is order-invariant).
+    A violation here would be an aliasing/cleanliness hole of exactly the
+    kind investigated for the L13 48-state deficit (ROUND4_NOTES.md)."""
+    import numpy as np
+    from raft_tla_tpu.models.schema import encode_state as enc
+    from raft_tla_tpu.ops.fingerprint import build_fingerprint
+    fingerprint = jax.jit(build_fingerprint(DIMS))
+
+    def check_state(s):
+        st = enc(s, DIMS)
+        cands, enabled, overflow = jax.device_get(expand(st))
+        assert not overflow.any()
+        for g in range(DIMS.n_instances):
+            if not enabled[g]:
+                continue
+            row = jax.tree.map(lambda a: a[g], cands)
+            batch = StateBatch(*row)
+            kh, kl = (int(x) for x in fingerprint(batch))
+            canon = enc(decode_state(batch, DIMS), DIMS)
+            ch, cl = (int(x) for x in fingerprint(canon))
+            assert (kh, kl) == (ch, cl), (
+                f"kernel row for instance {DIMS.describe_instance(g)} "
+                f"fingerprints differently from its canonical re-encoding"
+                f"\nstate: {s}")
+
+    res = orc.bfs([init_state(DIMS)], DIMS, max_levels=3)
+    rng = np.random.RandomState(11)
+    sample = sorted(res.parent, key=hash)
+    for s in (sample[::5][:120]
+              + list(smoke.random_states(DIMS, count=40, seed=23))):
+        check_state(s)
