@@ -28,8 +28,22 @@ BENCH_SECONDS = float(os.environ.get("BENCH_SECONDS", "45"))
 ORACLE_SECONDS = float(os.environ.get("BENCH_ORACLE_SECONDS",
                                       str(BENCH_SECONDS)))
 
+_T0 = time.time()
 
-def _tpu_tunnel_alive(timeout_s: float = 120.0) -> bool:
+
+def _mark(msg: str) -> None:
+    """Timestamped stderr progress marker.  The first TPU-tunnel window
+    (2026-07-31) died mid-bench with zero output after 900 s — these
+    markers localize any future stall without polluting the one-line
+    stdout JSON contract."""
+    print(f"bench[{time.time() - _T0:7.1f}s] {msg}", file=sys.stderr,
+          flush=True)
+
+
+def _tpu_tunnel_alive(timeout_s: float = 180.0) -> bool:
+    # 180 s matches the watchdog/session probes exactly: a tunnel that
+    # passes their probe must not time out here and demote the session's
+    # headline stage to a CPU run (burning a MAX_SESSION_FAILS credit).
     """Probe the accelerator in a SUBPROCESS with a hard timeout.
 
     A wedged TPU tunnel (observed: the axon relay accepts the connection
@@ -52,7 +66,8 @@ def main():
     # An explicit JAX_PLATFORMS=cpu must actually take effect: the boot
     # hook pins the axon backend by config, so the env var alone is
     # ignored and `import jax` would still block on a dead tunnel.
-    from raft_tla_tpu.utils.platform import neutralize_axon_if_cpu_requested
+    from raft_tla_tpu.utils.platform import (
+        enable_persistent_cache, neutralize_axon_if_cpu_requested)
     neutralize_axon_if_cpu_requested()
     # Otherwise probe the tunnel in a subprocess before touching it.
     if "cpu" not in os.environ.get("JAX_PLATFORMS", "") \
@@ -61,6 +76,8 @@ def main():
               file=sys.stderr)
         from raft_tla_tpu.utils.platform import force_cpu
         force_cpu()
+    _mark("tunnel probe done")
+    enable_persistent_cache()
     import jax
 
     platform = None
@@ -70,6 +87,7 @@ def main():
         from raft_tla_tpu.utils.platform import force_cpu
         force_cpu()
         platform = jax.devices()[0].platform
+    _mark(f"backend up: {platform}")
 
     on_accel = platform not in ("cpu",)
     from raft_tla_tpu.engine.bfs import EngineConfig
@@ -78,12 +96,22 @@ def main():
 
     here = os.path.dirname(os.path.abspath(__file__))
     setup = load_config(os.path.join(here, "configs/MCraft_bounded.cfg"))
+    # Accelerator capacities are EXPLICIT and modest (~3.5 GB total), not
+    # HBM-auto-sized: the only tunnel window ever observed (2026-07-31)
+    # wedged during this bench's ~9 GB auto-sized allocation+compile and
+    # never produced a number, while the profile stage's smaller footprint
+    # ran fine minutes earlier.  A 45-60 s window generates < 2 M distinct
+    # states — 2^21 queue rows and a 2^25-key table are ample, and the
+    # spill path covers any overshoot.  Env overrides for experiments.
+    qcap = int(os.environ.get("BENCH_QUEUE_CAP",
+                              str(1 << 21 if on_accel else 1 << 19)))
+    scap = int(os.environ.get("BENCH_SEEN_CAP",
+                              str(1 << 25 if on_accel else 1 << 21)))
     cfg = EngineConfig(
-        batch=2048 if on_accel else 512,
-        # None => sized from the chip's reported HBM; the frontier spills
-        # to host RAM past that, so no level size can crash the run.
-        queue_capacity=None if on_accel else 1 << 19,
-        seen_capacity=None if on_accel else 1 << 21,
+        batch=int(os.environ.get("BENCH_BATCH",
+                                 str(2048 if on_accel else 512))),
+        queue_capacity=qcap,
+        seen_capacity=scap,
         check_deadlock=False,
         record_trace=False,          # raw engine throughput (trace store is
         max_seconds=BENCH_SECONDS)   # host-side; C++ store tracked separately)
@@ -93,8 +121,13 @@ def main():
     n_dev = len(jax.devices())
     engine = make_engine(setup, cfg, engine_cls="auto")
     is_mesh = type(engine).__name__ == "MeshBFSEngine"
+    _mark(f"engine built ({'mesh' if is_mesh else 'single'}, "
+          f"batch={cfg.batch}); compiling + running "
+          f"{BENCH_SECONDS:.0f}s budget")
     res = engine.run(initial_states(setup))
     rate = res.distinct / res.wall_seconds if res.wall_seconds else 0.0
+    _mark(f"engine run done: {res.distinct} distinct in "
+          f"{res.wall_seconds:.1f}s; starting oracle window")
 
     # Python-oracle baseline on the same model (CPU, single core), over
     # the SAME wall budget from the same root — comparable windows, so the
@@ -112,6 +145,7 @@ def main():
                    stop_predicate=lambda r: time.time() - t0 > ORACLE_SECONDS)
     base_wall = time.time() - t0
     base_rate = ores.distinct_states / base_wall if base_wall else 1.0
+    _mark("oracle window done; emitting JSON")
 
     print(json.dumps({
         "metric": "distinct_states_per_sec",

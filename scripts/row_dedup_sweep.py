@@ -1,0 +1,216 @@
+"""Localize the L13 48-state deficit: digest-dedup vs engine-row-dedup.
+
+Context (ROUND4_NOTES.md, fingerprint.py docstring): at MCraft_bounded
+level 13 the engine counts 63,312,389 distinct vs the oracle's 63,312,437
+(-48), bit-identically under two independent fingerprint designs — so the
+deficit is NOT hash collisions.  Two mutually-exclusive explanations
+remain, and this sweep decides between them while capturing the exact
+pairs:
+
+(a) ENGINE ENCODING HOLE: 48 pairs of spec-distinct states alias to the
+    same canonical StateBatch content (the fingerprint's input), so the
+    engine merges them.  Then the pair's two PyStates differ structurally.
+(b) ORACLE OVERCOUNT: oracle_exhaust.py's canon_digest pickles raw state
+    tuples; any value-equal-but-representation-different states (or a
+    non-canonical detail the spec does not distinguish) split one spec
+    state into two digests.  Then the pair's two PyStates are value-equal.
+
+Method: one oracle BFS sweep (dedup by the same BLAKE digest as
+oracle_exhaust.py) that ALSO maps every state to a digest of its
+ENGINE-CANONICAL ROW — a pure-Python, type-normalized mirror of
+models/schema.py's encode_state content with the message bag as a sorted
+(row, count) multiset, exactly the information ops/fingerprint.py hashes.
+When two digest-distinct states map to one row digest, the second arrival
+is pickled immediately; a second, targeted sweep then captures the first
+arrivals (phase 2 — only runs if phase 1 flagged anything).
+
+Usage: python scripts/row_dedup_sweep.py [cfg] [out.jsonl] [max_levels]
+Artifacts: artifacts/row_alias_pairs.pkl (list of {rowdigest, phase,
+           level, state}), out.jsonl (per-level digest vs row counts).
+"""
+
+import json
+import os
+import pickle
+import sys
+import time
+from hashlib import blake2b
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from raft_tla_tpu.models import oracle as orc
+from raft_tla_tpu.models.dims import AEQ, RVQ, RVR
+from raft_tla_tpu.models.invariants import constraint_py
+from raft_tla_tpu.models.pystate import init_state
+from raft_tla_tpu.utils.cfg import load_config
+
+
+def canon_digest(s) -> bytes:
+    """Spec-side digest — BYTE-IDENTICAL to oracle_exhaust.canon_digest."""
+    canon = (s.current_term, s.role, s.voted_for, s.log, s.commit_index,
+             s.votes_responded, s.votes_granted, s.next_index,
+             s.match_index, tuple(sorted(s.messages)))
+    return blake2b(pickle.dumps(canon, protocol=5), digest_size=16).digest()
+
+
+def build_row_digest(dims):
+    """Engine-canonical-row digest: the information content of
+    models/schema.py encode_state + ops/fingerprint.py's bag treatment
+    (multiset of (packed row, count)), with every value normalized to a
+    Python int so representation differences cannot split a row."""
+    L, W = dims.max_log, dims.msg_width
+
+    def encode_msg(m):
+        """Mirror of schema.encode_message, as a W-tuple of ints."""
+        w = [0] * W
+        mtype, src, dst, mterm = int(m[0]), int(m[1]), int(m[2]), int(m[3])
+        w[0], w[1], w[2], w[3] = mtype + 1, src + 1, dst + 1, mterm
+        if mtype == RVQ:
+            w[4], w[5] = int(m[4]), int(m[5])
+        elif mtype == RVR:
+            granted, mlog = m[4], m[5]
+            w[4], w[5] = int(granted), len(mlog)
+            for k, (t, v) in enumerate(mlog):
+                w[6 + k] = int(t)
+                w[6 + L + k] = int(v)
+        elif mtype == AEQ:
+            prev, pterm, entries, mcommit = m[4], m[5], m[6], m[7]
+            w[4], w[5], w[6] = int(prev), int(pterm), len(entries)
+            if entries:
+                w[7], w[8] = int(entries[0][0]), int(entries[0][1])
+            w[9] = int(mcommit)
+        else:
+            w[4], w[5] = int(m[4]), int(m[5])
+        return tuple(w)
+
+    def row_digest(s) -> bytes:
+        logs = tuple(
+            (tuple(int(t) for t, _ in lg) + (0,) * (L - len(lg)),
+             tuple(int(v) for _, v in lg) + (0,) * (L - len(lg)),
+             len(lg))
+            for lg in s.log)
+        bag = tuple(sorted(
+            (encode_msg(m), int(c)) for m, c in s.messages))
+        canon = (tuple(int(x) for x in s.current_term),
+                 tuple(int(x) for x in s.role),
+                 tuple(int(x) for x in s.voted_for),
+                 logs,
+                 tuple(int(x) for x in s.commit_index),
+                 tuple(int(x) for x in s.votes_responded),
+                 tuple(int(x) for x in s.votes_granted),
+                 tuple(tuple(int(x) for x in r) for r in s.next_index),
+                 tuple(tuple(int(x) for x in r) for r in s.match_index),
+                 bag)
+        return blake2b(pickle.dumps(canon, protocol=5),
+                       digest_size=16).digest()
+
+    return row_digest
+
+
+def sweep(setup, max_levels, out_path, flagged_rows=None):
+    """One BFS sweep.  Phase 1 (flagged_rows=None): build row->canon map,
+    log second arrivals of any row collision.  Phase 2 (flagged_rows=set):
+    no map, just capture every state whose row digest is flagged."""
+    dims, bounds = setup.dims, setup.bounds
+    constraint = constraint_py(bounds)
+    row_digest = build_row_digest(dims)
+    t0 = time.time()
+
+    seen = set()
+    row_map = {} if flagged_rows is None else None
+    hits = []
+    distinct = generated = 0
+    frontier = []
+    for s0 in [init_state(dims)]:
+        d = canon_digest(s0)
+        seen.add(d)
+        distinct += 1
+        rd = row_digest(s0)
+        if row_map is not None:
+            row_map[rd] = d
+        elif rd in flagged_rows:
+            hits.append({"rowdigest": rd.hex(), "phase": 2, "level": 0,
+                         "state": s0})
+        if constraint(s0, dims):
+            frontier.append(s0)
+
+    level = 0
+    out = open(out_path, "a" if flagged_rows else "w")
+
+    def emit(reason="running"):
+        nrows = len(row_map) if row_map is not None else -1
+        rec = {"phase": 1 if flagged_rows is None else 2, "level": level,
+               "frontier": len(frontier), "distinct": distinct,
+               "row_distinct": nrows, "generated": generated,
+               "aliases": len(hits), "wall_s": round(time.time() - t0, 1),
+               "stop_reason": reason}
+        out.write(json.dumps(rec) + "\n")
+        out.flush()
+        print(rec, flush=True)
+
+    emit()
+    while frontier and (max_levels is None or level < max_levels):
+        nxt = []
+        for s in frontier:
+            succ = orc.successors(s, dims)
+            generated += len(succ)
+            for _act, t in succ:
+                d = canon_digest(t)
+                if d in seen:
+                    continue
+                seen.add(d)
+                distinct += 1
+                rd = row_digest(t)
+                if row_map is not None:
+                    prev = row_map.get(rd)
+                    if prev is None:
+                        row_map[rd] = d
+                    else:
+                        # Digest-distinct, row-equal: the second arrival
+                        # of an alias pair.  Capture it NOW (its partner
+                        # is phase 2's job).
+                        hits.append({"rowdigest": rd.hex(), "phase": 1,
+                                     "level": level + 1, "state": t})
+                elif rd in flagged_rows:
+                    hits.append({"rowdigest": rd.hex(), "phase": 2,
+                                 "level": level + 1, "state": t})
+                if constraint(t, dims):
+                    nxt.append(t)
+        level += 1
+        frontier = nxt
+        emit()
+    emit("done")
+    out.close()
+    return hits, distinct, (len(row_map) if row_map is not None else None)
+
+
+def main():
+    cfg_path = sys.argv[1] if len(sys.argv) > 1 else \
+        "configs/MCraft_bounded.cfg"
+    out_path = sys.argv[2] if len(sys.argv) > 2 else \
+        "artifacts/row_dedup_sweep.jsonl"
+    max_levels = int(sys.argv[3]) if len(sys.argv) > 3 else 13
+    setup = load_config(cfg_path)
+
+    hits, distinct, row_distinct = sweep(setup, max_levels, out_path)
+    print(json.dumps({"phase": 1, "digest_distinct": distinct,
+                      "row_distinct": row_distinct,
+                      "alias_second_arrivals": len(hits)}), flush=True)
+    pkl = "artifacts/row_alias_pairs.pkl"
+    if hits:
+        flagged = {bytes.fromhex(h["rowdigest"]) for h in hits}
+        hits2, _, _ = sweep(setup, max_levels, out_path,
+                            flagged_rows=flagged)
+        with open(pkl, "wb") as f:
+            pickle.dump(hits + hits2, f)
+        print(json.dumps({"phase": 2, "captured": len(hits) + len(hits2),
+                          "pkl": pkl}), flush=True)
+    else:
+        with open(pkl, "wb") as f:
+            pickle.dump([], f)
+        print(json.dumps({"phase": 2, "captured": 0,
+                          "note": "no aliases found"}), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,16 +1,22 @@
 #!/bin/bash
-# One-shot TPU measurement session (round-3 performance evidence).
-# Run when the TPU tunnel is alive; everything lands in artifacts/.
+# One-shot TPU measurement session.  Run when the TPU tunnel is alive;
+# everything lands in artifacts/.
 #
 #   bash scripts/tpu_session.sh [budget_seconds_for_northstar]
 #
-# Stages (each skipped gracefully if a prior one shows the tunnel dead):
+# Ordering lesson (2026-07-31, the only tunnel window ever observed): the
+# tunnel lived ~5 minutes — long enough for exactly one stage — then
+# wedged mid-bench and stayed dead.  So the DRIVER METRIC (bench) runs
+# FIRST now, and each stage re-probes and simply skips (not aborts) so a
+# transient wedge costs one stage, not the rest of the session.
+#
+# Stages, in value order:
 #   1. probe           - fail fast if the tunnel is wedged
-#   2. profile_step    - per-stage device timings (the round-3 instrument)
-#   3. bench           - the driver metric (BENCH_SECONDS=60)
-#   4. north star      - raft5/TPUraft.cfg on one chip, checkpoint+spill,
-#                        budgeted; level profile recorded
-#   5. simulation      - BASELINE configs[3] scale (capped by time budget)
+#   2. bench           - the driver metric (BENCH_SECONDS=60)
+#   3. leader bench    - leader-rich frontier (log-machinery kernels)
+#   4. profile_step    - per-stage device timings
+#   5. north star      - raft5/TPUraft.cfg on one chip, checkpoint+spill
+#   6. simulation      - BASELINE configs[3] scale (capped)
 set -u
 set -o pipefail   # a crashed stage must not be masked by tee
 cd "$(dirname "$0")/.."
@@ -42,40 +48,74 @@ if ! probe; then
 fi
 echo "tpu ok"
 
-# Single-core host: a background CPU measurement (e.g. the configs[3]
-# simulation sweep) would starve XLA compilation for every stage below —
-# the TPU session takes priority the moment the tunnel answers.
+# Single-core host: a background CPU measurement would starve XLA
+# compilation for every stage below — the TPU session takes priority the
+# moment the tunnel answers.
 pkill -f "raft_tla_tpu simulate.*platform cpu" 2>/dev/null && \
     echo "(killed background CPU simulation sweep; TPU session takes priority)"
 
-echo "== 2. profile_step (B=2048) =="
-timeout 1200 python scripts/profile_step.py 2048 \
-    2> artifacts/profile_step_tpu.log | tee artifacts/profile_step_tpu.txt
-
-echo "== 3. bench (60 s budget) =="
+echo "== 2. bench (60 s budget) =="
 # stdout only into the .json — bench prints exactly one JSON line there;
-# stderr (fallback notices, absl logs) goes to the .log.
-probe || { echo "tunnel died before bench; stopping"; exit 1; }
+# stderr (progress markers, fallback notices, absl logs) goes to the .log.
+# A previously captured result is archived, never truncated by a rerun.
+for f in bench_tpu.json leader_bench_tpu.json; do
+    [ -s "artifacts/$f" ] && cp "artifacts/$f" "artifacts/$f.$(date +%s).bak"
+done
 BENCH_SECONDS=60 timeout 900 python bench.py \
-    2> artifacts/bench_tpu.log | tee artifacts/bench_tpu.json
+    2> artifacts/bench_tpu.log | tee artifacts/bench_tpu.json \
+    || echo "bench stage failed (rc=$?)"
 
-echo "== 3b. leader-rich bench (60 s) =="
-probe || { echo "tunnel died before leader bench; stopping"; exit 1; }
-timeout 900 python scripts/leader_bench.py 60 \
-    2> artifacts/leader_bench_tpu.log | tee artifacts/leader_bench_tpu.json
+echo "== 3. leader-rich bench (60 s) =="
+if probe; then
+    timeout 900 python scripts/leader_bench.py 60 \
+        2> artifacts/leader_bench_tpu.log \
+        | tee artifacts/leader_bench_tpu.json \
+        || echo "leader bench failed (rc=$?)"
+else
+    echo "skipped: tunnel dead"
+fi
 
-echo "== 4. north-star attempt (budget ${NS_BUDGET}s, ckpt+spill) =="
-probe || { echo "tunnel died before north star; stopping"; exit 1; }
-timeout $((NS_BUDGET + 600)) python -m raft_tla_tpu check \
-    configs/TPUraft.cfg ${PLAT_ARGS} --max-seconds "${NS_BUDGET}" --no-trace \
-    --checkpoint-dir artifacts/ns_ckpt --spill-dir artifacts/ns_spill \
-    2> artifacts/northstar_tpu.log | tee artifacts/northstar_tpu.txt
+echo "== 4. profile_step (B=2048) =="
+if probe; then
+    timeout 1200 python scripts/profile_step.py 2048 \
+        2> artifacts/profile_step_tpu.log \
+        | tee artifacts/profile_step_tpu.txt \
+        || echo "profile stage failed (rc=$?)"
+else
+    echo "skipped: tunnel dead"
+fi
 
-echo "== 5. simulation at scale (300 s cap) =="
-probe || { echo "tunnel died before simulate; stopping"; exit 1; }
-timeout 600 python -m raft_tla_tpu simulate configs/MCraft_bounded.cfg \
-    ${PLAT_ARGS} --batch 8192 --num-steps 134217728 --max-seconds 300 \
-    2> artifacts/simulate_tpu.log | tee artifacts/simulate_tpu.txt
+echo "== 5. north-star attempt (budget ${NS_BUDGET}s, ckpt+spill) =="
+if probe; then
+    timeout $((NS_BUDGET + 600)) python -m raft_tla_tpu check \
+        configs/TPUraft.cfg ${PLAT_ARGS} --max-seconds "${NS_BUDGET}" \
+        --no-trace \
+        --checkpoint-dir artifacts/ns_ckpt --spill-dir artifacts/ns_spill \
+        2> artifacts/northstar_tpu.log | tee artifacts/northstar_tpu.txt \
+        || echo "north-star stage failed (rc=$?)"
+else
+    echo "skipped: tunnel dead"
+fi
+
+echo "== 6. simulation at scale (300 s cap) =="
+if probe; then
+    timeout 600 python -m raft_tla_tpu simulate configs/MCraft_bounded.cfg \
+        ${PLAT_ARGS} --batch 8192 --num-steps 134217728 --max-seconds 300 \
+        2> artifacts/simulate_tpu.log | tee artifacts/simulate_tpu.txt \
+        || echo "simulate stage failed (rc=$?)"
+else
+    echo "skipped: tunnel dead"
+fi
 
 echo "== session complete; artifacts/ =="
 ls -la artifacts/
+# Exit 0 only if the headline stage produced a REAL accelerator artifact —
+# bench.py falls back to CPU (and still emits JSON) when the tunnel dies
+# mid-session, and the watchdog must keep probing in that case, not
+# declare victory on a CPU number.
+if [ "${RAFT_SESSION_ALLOW_CPU:-0}" = "1" ]; then
+    [ -s artifacts/bench_tpu.json ]
+else
+    [ -s artifacts/bench_tpu.json ] \
+        && ! grep -q '"platform": "cpu"' artifacts/bench_tpu.json
+fi

@@ -11,7 +11,7 @@
 set -u
 cd "$(dirname "$0")/.."
 mkdir -p artifacts
-LOG=artifacts/tpu_watchdog_r04.log
+LOG=artifacts/tpu_watchdog_r05.log
 NS_BUDGET="${1:-900}"
 MAX_SESSION_FAILS="${MAX_SESSION_FAILS:-3}"
 fails=0
@@ -24,7 +24,7 @@ probe() {
 while true; do
     if probe; then
         echo "$(date -u +%FT%TZ) probe OK - launching tpu_session" >> "$LOG"
-        bash scripts/tpu_session.sh "$NS_BUDGET" >> artifacts/tpu_session_r04.out 2>&1
+        bash scripts/tpu_session.sh "$NS_BUDGET" >> artifacts/tpu_session_r05.out 2>&1
         rc=$?
         echo "$(date -u +%FT%TZ) tpu_session exit rc=$rc" >> "$LOG"
         [ $rc -eq 0 ] && exit 0
