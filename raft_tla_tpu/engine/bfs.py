@@ -86,6 +86,16 @@ class EngineConfig:
     # (16*batch); any value is floored at max(G, batch) and rounded to a
     # power of two (ops/compact.py choose_k).
     compact_lanes: Optional[int] = None
+    # Successor pipeline: "auto" = the v2 delta pipeline (models/
+    # actions2.py — guards-only masks, delta fingerprints, K-lane sparse
+    # construction; the TPU-profile-driven rework) wherever it applies
+    # (base action alphabet), v1 expand for spec variants with
+    # extra_families.  "v1"/"v2" force one path (v2 raises on variants).
+    pipeline: str = "auto"
+    # Lane-compaction lowering (ops/compact.py): "scatter" (original) or
+    # "searchsorted" (binary-search inversion; identical outputs).  Kept
+    # switchable until a TPU profile picks the winner.
+    compact_method: str = "scatter"
     # None = defer to the cfg file (make_engine fills it in); a bool from
     # the caller always wins — the documented precedence chain.
     check_deadlock: Optional[bool] = None
@@ -231,6 +241,18 @@ def _auto_capacities(sw: int, batch: int,
     return q, s
 
 
+def _resolve_pipeline(requested: str, dims):
+    """EngineConfig.pipeline -> a v2 pipeline object or None (v1)."""
+    from ..models.actions2 import build_v2
+    if requested == "v1":
+        return None
+    if requested == "v2":
+        return build_v2(dims)       # raises on extra_families variants
+    if requested != "auto":
+        raise ValueError(f"pipeline must be auto/v1/v2, got {requested!r}")
+    return None if dims.extra_families else build_v2(dims)
+
+
 def find_root_violation(root_check, encoded, init_states, batch_size,
                         inv_names) -> Optional[Violation]:
     """Run ``build_root_check``'s program over the encoded roots in
@@ -265,6 +287,7 @@ class BFSEngine:
         expand = build_expand(dims)
         fingerprint = build_fingerprint(dims)
         pack_ok = build_pack_guard(dims)
+        self._v2 = _resolve_pipeline(cfg.pipeline, dims)
         sw = state_width(dims)
         B, G = cfg.batch, dims.n_instances
         # Compacted-candidate lanes (ops/compact.py owns the invariants).
@@ -380,7 +403,8 @@ class BFSEngine:
         # every batch triggers a spill — correct, just not fast.
         QTH = Q - K
         self._QTH = QTH
-        compactor = compact_mod.build_compactor(B, G, K)
+        compactor = compact_mod.build_compactor(
+            B, G, K, method=cfg.compact_method)
 
         # The per-batch pipeline body is shared with the mesh engine
         # (engine/chunk.py) — only the insert function differs.
@@ -388,7 +412,7 @@ class BFSEngine:
             dims=dims, expand=expand, fingerprint=fingerprint,
             pack_ok=pack_ok, inv_fns=inv_fns, constraint=constraint,
             B=B, G=G, K=K, Q=Q, TQ=TQ, record_static=record_static,
-            compactor=compactor, insert_fn=fpset.insert)
+            compactor=compactor, insert_fn=fpset.insert, v2=self._v2)
 
         def chunk(qcur, cur_count, offset0, qnext, next_count, seen,
                   tbuf, tcount0, max_steps):

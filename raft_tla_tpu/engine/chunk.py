@@ -40,13 +40,21 @@ _I32 = jnp.int32
 
 def build_chunk_body(*, dims, expand, fingerprint, pack_ok, inv_fns,
                      constraint, B, G, K, Q, TQ, record_static, compactor,
-                     insert_fn):
+                     insert_fn, v2=None):
     """Returns ``chunk_body(qcur, cur_count, carry) -> carry'``.
 
     ``Q`` is the live next-queue capacity (per chip for the mesh); masked
     enqueue lanes write trash slots [Q, Q+K), masked trace lanes write
     [TQ, TQ+K) — the caller allocates the padding (engine/bfs.py capacity
-    comment)."""
+    comment).
+
+    ``v2`` (models/actions2.build_v2 result, or None) selects the delta
+    pipeline: guards-only masks over the B*G lanes, then delta
+    fingerprints + sparse successor construction on the K compacted lanes
+    only.  Bit-identical to the v1 path in every carry field (enabled/
+    overflow masks, fingerprints, successor rows, per-family stats) —
+    property-tested in tests/test_actions2.py — so the two paths share
+    checkpoints and differential baselines freely."""
     BG = B * G
     inv_id = build_inv_id(inv_fns) if inv_fns else None
 
@@ -59,12 +67,18 @@ def build_chunk_body(*, dims, expand, fingerprint, pack_ok, inv_fns,
         rows = jax.lax.dynamic_slice_in_dim(qcur, offset, B, axis=0)
         valid = (offset + jnp.arange(B, dtype=_I32)) < cur_count
         states = jax.vmap(unflatten_state, (0, None))(rows, dims)
-        cands, en, ovf = jax.vmap(expand)(states)
-        en = en & valid[:, None]
-        # A successor whose term/bag count outgrew the uint8 row is an
-        # overflow too (schema.build_pack_guard): stop, never alias.
-        ovf = (ovf | (en & ~jax.vmap(jax.vmap(pack_ok))(cands))) \
-            & valid[:, None]
+        if v2 is None:
+            cands, en, ovf = jax.vmap(expand)(states)
+            en = en & valid[:, None]
+            # A successor whose term/bag count outgrew the uint8 row is an
+            # overflow too (schema.build_pack_guard): stop, never alias.
+            ovf = (ovf | (en & ~jax.vmap(jax.vmap(pack_ok))(cands))) \
+                & valid[:, None]
+        else:
+            # Masks fold the pack guard in at the same lanes (actions2).
+            en, ovf = jax.vmap(v2.masks)(states)
+            en = en & valid[:, None]
+            ovf = ovf & valid[:, None]
 
         # Progress limiting + lane compaction (ops/compact.py): take the
         # longest parent prefix whose fan-out fits K, compact the enabled
@@ -85,10 +99,22 @@ def build_chunk_body(*, dims, expand, fingerprint, pack_ok, inv_fns,
         # holds, and any overflow aborts the run above).  Hashing before
         # compaction would read every field of all B*G lanes for the
         # ~94% that are disabled.
-        cflat = jax.tree.map(
-            lambda a: a.reshape((BG,) + a.shape[2:]), cands)
-        kstates = jax.tree.map(lambda a: a[lane_id], cflat)
-        kh, kl = jax.vmap(fingerprint)(kstates)             # [K]
+        if v2 is None:
+            cflat = jax.tree.map(
+                lambda a: a.reshape((BG,) + a.shape[2:]), cands)
+            kstates = jax.tree.map(lambda a: a[lane_id], cflat)
+            kh, kl = jax.vmap(fingerprint)(kstates)         # [K]
+        else:
+            # Gather K parent structs (from B parents, not B*G candidate
+            # lanes) and construct only those successors, with their
+            # fingerprints coming from the parents' hash sums + per-lane
+            # deltas (models/actions2.py).
+            ph = jax.vmap(v2.parent_hash)(states)
+            pidx = lane_id // G
+            kparents = jax.tree.map(lambda a: a[pidx], states)
+            kph = jax.tree.map(lambda a: a[pidx], ph)
+            kh, kl, kstates = jax.vmap(v2.lane_out)(
+                kparents, kph, lane_id % G)
 
         seen, new, fail = insert_fn(seen, kh, kl, kvalid)
         if inv_id is not None:
@@ -111,7 +137,10 @@ def build_chunk_body(*, dims, expand, fingerprint, pack_ok, inv_fns,
         next_count = next_count + jnp.sum(enq, dtype=_I32)
 
         if record_static:
-            php, plp = jax.vmap(fingerprint)(states)  # parent fps [B]
+            if v2 is None:
+                php, plp = jax.vmap(fingerprint)(states)  # parent fps [B]
+            else:
+                php, plp = jax.vmap(v2.parent_fp)(ph)
             parent_hi = php[lane_id // G]
             parent_lo = plp[lane_id // G]
             actions = lane_id % G

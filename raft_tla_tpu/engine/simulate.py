@@ -55,28 +55,44 @@ class SimResult:
 
 
 def build_sim_chunk(dims: RaftDims, inv_fns, constraint, B: int, D: int,
-                    chunk: int):
+                    chunk: int, pipeline: str = "auto"):
     """Returns ``chunk_fn(rows, roots, tstep, cur_root, abuf, key)`` — the
     scan'd walker advance both the single-chip Simulator and the sharded
     parallel.simulate.MeshSimulator run (each chip is just an independent
-    walker fleet with its own PRNG key; simulation never communicates)."""
+    walker fleet with its own PRNG key; simulation never communicates).
+
+    With the v2 pipeline (models/actions2.py; ``pipeline`` as in
+    EngineConfig), each walker step computes guard masks only and
+    constructs ONE successor — the drawn action — instead of all G
+    candidates; masks/choice/successors are bit-identical to the v1
+    path, so seeded runs agree across pipelines."""
     expand = build_expand(dims)
     pack_ok = build_pack_guard(dims)
     inv_id = build_inv_id(inv_fns)
+    from .bfs import _resolve_pipeline
+    v2 = _resolve_pipeline(pipeline, dims)
 
     def body(carry, key):
         (rows, roots, tstep, cur_root, abuf, restarts, latch) = carry
         states = jax.vmap(unflatten_state, (0, None))(rows, dims)
-        cands, en, ovf = jax.vmap(expand)(states)
-        # uint8-row wrap counts as overflow (schema.build_pack_guard):
-        # the walker restarts rather than stepping through an aliased
-        # row.  Invariants are still checked on the pre-pack candidate.
-        ovf = ovf | (en & ~jax.vmap(jax.vmap(pack_ok))(cands))
+        if v2 is None:
+            cands, en, ovf = jax.vmap(expand)(states)
+            # uint8-row wrap counts as overflow (schema.build_pack_guard):
+            # the walker restarts rather than stepping through an aliased
+            # row.  Invariants are still checked on the pre-pack candidate.
+            ovf = ovf | (en & ~jax.vmap(jax.vmap(pack_ok))(cands))
+        else:
+            en, ovf = jax.vmap(v2.masks)(states)    # pack guard folded in
         # Uniform choice among enabled instances (masked categorical).
         logits = jnp.where(en, 0.0, -jnp.inf)
         choice = jax.random.categorical(key, logits, axis=-1)    # [B]
         can_step = jnp.any(en, axis=1)
-        nxt = jax.tree.map(lambda a: a[jnp.arange(B), choice], cands)
+        if v2 is None:
+            nxt = jax.tree.map(lambda a: a[jnp.arange(B), choice], cands)
+        else:
+            ph = jax.vmap(v2.parent_hash)(states)   # DCE'd: hashes unused
+            _h, _l, nxt = jax.vmap(v2.lane_out)(states, ph,
+                                                choice.astype(_I32))
         nrows = jax.vmap(flatten_state, (0, None))(nxt, dims)
 
         if inv_fns:
@@ -131,7 +147,8 @@ class Simulator:
     def __init__(self, dims: RaftDims,
                  invariants: Optional[Dict[str, Callable]] = None,
                  constraint: Optional[Callable] = None,
-                 batch: int = 256, depth: int = 100, chunk: int = 128):
+                 batch: int = 256, depth: int = 100, chunk: int = 128,
+                 pipeline: str = "auto"):
         self.dims = dims
         self.inv_names = list((invariants or {}).keys())
         inv_fns = list((invariants or {}).values())
@@ -139,7 +156,7 @@ class Simulator:
         self._sw = state_width(dims)
         inv_id = build_inv_id(inv_fns)
         chunk_fn = build_sim_chunk(dims, inv_fns, constraint, batch, depth,
-                                   chunk)
+                                   chunk, pipeline=pipeline)
 
         def roots_inv(batch):
             # Takes the *unpacked* int32 StateBatch, not packed rows: uint8

@@ -91,6 +91,8 @@ class MeshBFSEngine:
         expand = build_expand(dims)
         fingerprint = build_fingerprint(dims)
         pack_ok = build_pack_guard(dims)
+        from ..engine.bfs import _resolve_pipeline
+        self._v2 = _resolve_pipeline(cfg.pipeline, dims)
         sw = state_width(dims)
         B, G = cfg.batch, dims.n_instances
         # Compacted-candidate lanes per chip (ops/compact.py): only K
@@ -134,7 +136,8 @@ class MeshBFSEngine:
         # pmin keeps every chip's offset advance identical — the chunk
         # body contains collectives, so trip counts must agree.
         compactor = compact_mod.build_compactor(
-            B, G, K, reduce_p=lambda p: jax.lax.pmin(p, "x"))
+            B, G, K, reduce_p=lambda p: jax.lax.pmin(p, "x"),
+            method=cfg.compact_method)
 
         def route_insert(seen_local, fph, fpl, valid):
             """Cross-chip owner dedup: route each valid fingerprint to its
@@ -220,7 +223,7 @@ class MeshBFSEngine:
             dims=dims, expand=expand, fingerprint=fingerprint,
             pack_ok=pack_ok, inv_fns=inv_fns, constraint=constraint,
             B=B, G=G, K=K, Q=QL, TQ=TQ, record_static=record_static,
-            compactor=compactor, insert_fn=route_insert)
+            compactor=compactor, insert_fn=route_insert, v2=self._v2)
 
         def sharded_chunk(qcur, cur_counts, offset0, qnext, next_counts,
                           shi, slo, ssize, tbuf, tcount0, max_steps):

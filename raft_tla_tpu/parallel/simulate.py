@@ -35,7 +35,7 @@ class MeshSimulator:
                  invariants: Optional[Dict[str, Callable]] = None,
                  constraint: Optional[Callable] = None,
                  batch: int = 256, depth: int = 100, chunk: int = 128,
-                 devices=None):
+                 devices=None, pipeline: str = "auto"):
         self.dims = dims
         self.inv_names = list((invariants or {}).keys())
         inv_fns = list((invariants or {}).values())
@@ -44,7 +44,7 @@ class MeshSimulator:
         self.n_dev = n = len(devices)
         self.mesh = Mesh(np.asarray(devices), ("x",))
         chunk_fn = build_sim_chunk(dims, inv_fns, constraint, batch, depth,
-                                   chunk)
+                                   chunk, pipeline=pipeline)
 
         def sharded(rows, roots, tstep, cur_root, abuf, keys):
             # Leading device axis of size 1 inside shard_map.

@@ -1,0 +1,81 @@
+"""Analyze artifacts/row_alias_pairs.pkl (from row_dedup_sweep.py).
+
+Groups captured states by row digest and, for each group, reports:
+- whether the pair is VALUE-EQUAL as spec states (=> the oracle's
+  canon_digest split one spec state into two: oracle overcount, engine
+  right), or
+- the exact structural diff (=> the engine's canonical encoding merges
+  two spec-distinct states: encoding injectivity hole, engine wrong),
+plus the decode(encode(s)) round-trip for each member, which localizes
+any lost field immediately.
+
+Usage: python scripts/inspect_alias_pairs.py [pkl]
+"""
+
+import os
+import pickle
+import sys
+from collections import defaultdict
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from raft_tla_tpu.models.schema import decode_state, encode_state
+from raft_tla_tpu.utils.cfg import load_config
+
+
+def diff_states(a, b):
+    out = []
+    for f in ("current_term", "role", "voted_for", "log", "commit_index",
+              "votes_responded", "votes_granted", "next_index",
+              "match_index"):
+        va, vb = getattr(a, f), getattr(b, f)
+        if va != vb:
+            out.append((f, va, vb))
+    if a.messages != b.messages:
+        only_a = sorted(set(a.messages) - set(b.messages))
+        only_b = sorted(set(b.messages) - set(a.messages))
+        out.append(("messages", only_a, only_b))
+    return out
+
+
+def main():
+    pkl = sys.argv[1] if len(sys.argv) > 1 else \
+        "artifacts/row_alias_pairs.pkl"
+    cfg = sys.argv[2] if len(sys.argv) > 2 else "configs/MCraft_bounded.cfg"
+    setup = load_config(cfg)
+    dims = setup.dims
+    with open(pkl, "rb") as f:
+        hits = pickle.load(f)
+    print(f"{len(hits)} captured states")
+    groups = defaultdict(list)
+    dedup = set()
+    for h in hits:
+        # A phase-2 sweep revisits both members of a pair, so a pkl from
+        # an older sweep may hold a state twice; keep each state once.
+        k2 = (h["rowdigest"], h["state"])
+        if k2 in dedup:
+            continue
+        dedup.add(k2)
+        groups[h["rowdigest"]].append(h)
+    print(f"{len(groups)} alias groups")
+    for rd, members in sorted(groups.items()):
+        print(f"\n=== row {rd[:16]}…  ({len(members)} members, levels "
+              f"{sorted(m['level'] for m in members)}, phases "
+              f"{sorted(m['phase'] for m in members)})")
+        states = [m["state"] for m in members]
+        for k, s in enumerate(states):
+            rt = decode_state(encode_state(s, dims), dims)
+            tag = "round-trip OK" if rt == s else \
+                f"ROUND-TRIP LOSSY: {diff_states(s, rt)}"
+            print(f"  member {k}: {tag}")
+        if len(states) >= 2:
+            d = diff_states(states[0], states[1])
+            if not d:
+                print("  PAIR VALUE-EQUAL -> oracle canon_digest artifact "
+                      "(engine right)")
+            else:
+                print(f"  PAIR DIFFERS -> encoding alias; diff: {d}")
+
+
+if __name__ == "__main__":
+    main()

@@ -67,9 +67,12 @@ def main():
     enable_persistent_cache()
     setup = load_config("configs/MCraft_bounded.cfg")
     dims = setup.dims
+    # The per-stage parts below instrument the v1 pipeline's components;
+    # the fused-CHUNK section at the end times BOTH pipelines (v1 expand
+    # vs the actions2 delta path) on the same warm frontier.
     cfg = EngineConfig(batch=B, queue_capacity=1 << 20,
                        seen_capacity=1 << 23, record_trace=False,
-                       check_deadlock=False)
+                       check_deadlock=False, pipeline="v1")
     eng = make_engine(setup, cfg)
     G, SW, Q, K = eng._G, eng._sw, eng._Q, eng._K
     QA = Q + eng._PAD
@@ -110,6 +113,17 @@ def main():
         _P, _total, lane_id, kvalid = compactor(en)
         return (cflat, lane_id, kvalid)
 
+    compactor_ss = build_compactor(B, G, K, method="searchsorted")
+
+    @jax.jit
+    def part_compact_ss(rows):
+        states = jax.vmap(unflatten_state, (0, None))(rows, dims)
+        cands, en, ovf = jax.vmap(expand)(states)
+        cflat = jax.tree.map(
+            lambda a: a.reshape((BG,) + a.shape[2:]), cands)
+        _P, _total, lane_id, kvalid = compactor_ss(en)
+        return (cflat, lane_id, kvalid)
+
     @jax.jit
     def part_fp(rows):
         # fingerprint AFTER compaction (engine/chunk.py order): gather K
@@ -142,6 +156,7 @@ def main():
     rows = qcur[:B]
     bench("expand", part_expand, rows)
     bench("expand + compact (K lanes)", part_compact, rows)
+    bench("expand + compact[searchsorted]", part_compact_ss, rows)
     _, (cflat, kh, kl, lane_id, kvalid) = bench(
         "expand + compact + fingerprint (K)", part_fp, rows)
     seen = fpset.empty(cfg.seen_capacity)
@@ -188,6 +203,42 @@ def main():
         out = chunk8(out[0], out[1], out[2])
     jax.block_until_ready(out)
     print(f"{'CHUNK x8 (8 batches per call)':42s} "
+          f"{(time.time() - t0) / n / 8 * 1e3:9.2f} ms/batch")
+
+    # The same fused chunk, v2 (delta) pipeline — models/actions2.py.
+    eng2 = make_engine(setup, EngineConfig(
+        batch=B, queue_capacity=1 << 20, seen_capacity=1 << 23,
+        record_trace=False, check_deadlock=False, pipeline="v2"))
+    qnext2 = jnp.zeros((QA, SW), jnp.uint8)
+    seen2 = fpset.empty(cfg.seen_capacity)
+    tbuf2 = tuple(jnp.zeros((eng2._TA,), d) for d in
+                  (jnp.uint32, jnp.uint32, jnp.uint32, jnp.uint32,
+                   jnp.int32))
+    out2 = eng2._chunk(qcur, jnp.int32(B), jnp.int32(0), qnext2,
+                       jnp.int32(0), seen2, tbuf2, jnp.int32(0),
+                       jnp.int32(1))
+    jax.block_until_ready(out2)
+    t0 = time.time()
+    for _ in range(n):
+        out2 = eng2._chunk(qcur, jnp.int32(B), jnp.int32(0), out2[0],
+                           jnp.int32(0), out2[1], out2[2], jnp.int32(0),
+                           jnp.int32(1))
+    jax.block_until_ready(out2)
+    print(f"{'CHUNK v2 (1 batch, delta pipeline)':42s} "
+          f"{(time.time() - t0) / n * 1e3:9.2f} ms")
+
+    def chunk8_v2(qnext, seen, tbuf):
+        return eng2._chunk(qcur, jnp.int32(8 * B), jnp.int32(0), qnext,
+                           jnp.int32(0), seen, tbuf, jnp.int32(0),
+                           jnp.int32(8))
+
+    out2 = chunk8_v2(out2[0], out2[1], out2[2])
+    jax.block_until_ready(out2)
+    t0 = time.time()
+    for _ in range(n):
+        out2 = chunk8_v2(out2[0], out2[1], out2[2])
+    jax.block_until_ready(out2)
+    print(f"{'CHUNK v2 x8 (8 batches per call)':42s} "
           f"{(time.time() - t0) / n / 8 * 1e3:9.2f} ms/batch")
 
 

@@ -201,6 +201,12 @@ def main():
         flagged = {bytes.fromhex(h["rowdigest"]) for h in hits}
         hits2, _, _ = sweep(setup, max_levels, out_path,
                             flagged_rows=flagged)
+        # Phase 2 revisits BOTH members of each pair; drop the second
+        # arrivals already captured in phase 1 so the pkl holds each
+        # state exactly once (inspect_alias_pairs groups by rowdigest).
+        seen1 = {canon_digest(h["state"]) for h in hits}
+        hits2 = [h for h in hits2
+                 if canon_digest(h["state"]) not in seen1]
         with open(pkl, "wb") as f:
             pickle.dump(hits + hits2, f)
         print(json.dumps({"phase": 2, "captured": len(hits) + len(hits2),

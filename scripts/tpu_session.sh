@@ -75,12 +75,16 @@ else
     echo "skipped: tunnel dead"
 fi
 
-echo "== 4. profile_step (B=2048) =="
+echo "== 4. profile_step (B=2048, then B=8192 batch-scaling probe) =="
 if probe; then
     timeout 1200 python scripts/profile_step.py 2048 \
         2> artifacts/profile_step_tpu.log \
         | tee artifacts/profile_step_tpu.txt \
         || echo "profile stage failed (rc=$?)"
+    timeout 1200 python scripts/profile_step.py 8192 \
+        2> artifacts/profile_step_tpu_b8192.log \
+        | tee artifacts/profile_step_tpu_b8192.txt \
+        || echo "profile b8192 failed (rc=$?)"
 else
     echo "skipped: tunnel dead"
 fi

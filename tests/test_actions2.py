@@ -1,0 +1,174 @@
+"""v2 (delta) pipeline vs v1 expand: bit-identical contract.
+
+The v2 pipeline (models/actions2.py) must match v1 (models/actions.py +
+ops/fingerprint.py + the chunk-level pack guard) EXACTLY — enabled and
+overflow masks over the whole action grid, fingerprints, and every field
+of every enabled successor — because the engines treat the two paths as
+interchangeable (shared checkpoints, shared differential baselines).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from raft_tla_tpu.models import oracle as orc
+from raft_tla_tpu.models.actions import build_expand
+from raft_tla_tpu.models.actions2 import build_v2
+from raft_tla_tpu.models.invariants import constraint_py
+from raft_tla_tpu.models.pystate import init_state
+from raft_tla_tpu.models.schema import build_pack_guard, encode_state
+from raft_tla_tpu.ops.fingerprint import build_fingerprint
+from raft_tla_tpu.utils.cfg import load_config
+
+
+@pytest.fixture(scope="module")
+def rig():
+    setup = load_config("configs/MCraft_bounded.cfg")
+    dims = setup.dims
+    expand = build_expand(dims)
+    fp = build_fingerprint(dims)
+    pack_ok = build_pack_guard(dims)
+    v2 = build_v2(dims)
+    G = dims.n_instances
+
+    @jax.jit
+    def v1_all(st):
+        cands, en, ovf = expand(st)
+        pk = jax.vmap(pack_ok)(cands)
+        h, l = jax.vmap(fp)(cands)
+        return cands, en, ovf | (en & ~pk), h, l
+
+    @jax.jit
+    def v2_all(st):
+        en, ovf = v2.masks(st)
+        ph = v2.parent_hash(st)
+        h, l, succ = jax.vmap(v2.lane_out, (None, None, 0))(
+            st, ph, jnp.arange(G, dtype=jnp.int32))
+        phi, plo = v2.parent_fp(ph)
+        return succ, en, ovf, h, l, phi, plo
+
+    return setup, dims, jax.jit(fp), v1_all, v2_all
+
+
+def _assert_state_matches(rig_, s, ctx=""):
+    setup, dims, fp1, v1_all, v2_all = rig_
+    st = jax.tree.map(jnp.asarray, encode_state(s, dims))
+    c1, en1, ovf1, h1, l1 = v1_all(st)
+    c2, en2, ovf2, h2, l2, phi, plo = v2_all(st)
+    rh, rl = fp1(st)
+    assert (int(phi), int(plo)) == (int(rh), int(rl)), f"parent fp {ctx}"
+    en1, en2, ovf1, ovf2 = map(np.asarray, (en1, en2, ovf1, ovf2))
+    bad_en = np.nonzero(en1 != en2)[0]
+    assert bad_en.size == 0, \
+        f"enabled mismatch {ctx} at " \
+        f"{[dims.describe_instance(int(g)) for g in bad_en[:4]]}"
+    bad_ovf = np.nonzero(ovf1 != ovf2)[0]
+    assert bad_ovf.size == 0, \
+        f"overflow mismatch {ctx} at " \
+        f"{[dims.describe_instance(int(g)) for g in bad_ovf[:4]]}"
+    h1, l1, h2, l2 = map(np.asarray, (h1, l1, h2, l2))
+    for g in np.nonzero(en1)[0]:
+        gi = int(g)
+        assert h1[g] == h2[g] and l1[g] == l2[g], \
+            f"fp mismatch {ctx} {dims.describe_instance(gi)}"
+        for name, a, b in zip(
+                c1._fields,
+                jax.tree.map(lambda a: np.asarray(a)[g], c1),
+                jax.tree.map(lambda a: np.asarray(a)[g], c2)):
+            assert (a == b).all(), \
+                f"succ field {name} {ctx} {dims.describe_instance(gi)}"
+
+
+def test_v2_matches_v1_on_reachable_states(rig):
+    setup, dims = rig[0], rig[1]
+    res = orc.bfs([init_state(dims)], dims,
+                  constraint=constraint_py(setup.bounds),
+                  check_deadlock=False, max_levels=5)
+    states = list(res.parent)[:120]
+    assert len(states) >= 100
+    for i, s in enumerate(states):
+        _assert_state_matches(rig, s, ctx=f"reachable[{i}]")
+
+
+def test_v2_matches_v1_on_leader_and_pack_edge_states(rig):
+    setup, dims = rig[0], rig[1]
+    import sys
+    sys.path.insert(0, "scripts")
+    from leader_bench import leader_states
+    extra = leader_states(dims, setup.bounds, 1)[:40]
+    assert extra, "leader seeding failed"
+    base = extra[0]
+    s_cnt = orc.timeout(init_state(dims), dims, 0)
+    mm = sorted(s_cnt.replace(messages=s_cnt.messages).messages)[0][0] \
+        if s_cnt.messages else None
+    crafted = [
+        # term at the uint8 edge: Timeout must overflow-flag, not wrap.
+        base.replace(current_term=tuple(255 for _ in base.current_term)),
+        base.replace(current_term=(254, 255, 255)),
+        # lastLogTerm > 127 breaks the signed msg column 4: RequestVote
+        # sends must overflow-flag (schema.build_pack_guard).
+        base.replace(current_term=(200, 200, 200),
+                     log=(((200, 1),), ((200, 2),), ())),
+    ]
+    if mm is not None:
+        crafted.append(s_cnt.replace(messages=frozenset({(mm, 255)})))
+        crafted.append(s_cnt.replace(messages=frozenset({(mm, 254)})))
+    # Bag at slot capacity: every send must take the overflow path
+    # (enabled=False, overflow=True), and receives must still work.
+    full_bag = frozenset(
+        ((0, src, dst, t, 1, 0), 1)
+        for src in range(dims.n_servers) for dst in range(dims.n_servers)
+        for t in range(1, 1 + dims.n_msg_slots
+                       // (dims.n_servers * dims.n_servers) + 1)
+    )
+    full_bag = frozenset(list(full_bag)[:dims.n_msg_slots])
+    crafted.append(s_cnt.replace(messages=full_bag))
+    for i, s in enumerate(extra + crafted):
+        _assert_state_matches(rig, s, ctx=f"corner[{i}]")
+
+
+def test_v2_rejects_variant_dims():
+    from raft_tla_tpu.models.reconfig import ReconfigDims
+    with pytest.raises(NotImplementedError):
+        build_v2(ReconfigDims(n_servers=2, n_values=1, max_log=2,
+                              n_msg_slots=8, targets=(0b11,)))
+
+
+def test_compactor_methods_identical():
+    """ops/compact.py: the searchsorted lowering must produce the exact
+    (P, total, lane_id, kvalid) of the scatter lowering — including the
+    spread addresses in dead slots."""
+    from raft_tla_tpu.ops.compact import build_compactor
+    rng = np.random.RandomState(7)
+    for B, G, K, p in ((8, 12, 16, 0.1), (16, 33, 64, 0.5),
+                       (4, 5, 8, 0.0), (8, 7, 8, 1.0)):
+        c1 = build_compactor(B, G, K, method="scatter")
+        c2 = build_compactor(B, G, K, method="searchsorted")
+        for _ in range(5):
+            en = jnp.asarray(rng.rand(B, G) < p)
+            r1 = c1(en)
+            r2 = c2(en)
+            for a, b, nm in zip(r1, r2, ("P", "total", "lane_id",
+                                         "kvalid")):
+                assert (np.asarray(a) == np.asarray(b)).all(), \
+                    f"{nm} differs at B={B} G={G} K={K} p={p}"
+
+
+def test_simulator_pipelines_agree_seeded():
+    """engine/simulate.py: v1 and v2 walker fleets draw identical actions
+    (masks are bit-identical), so a seeded run's step/trace/violation
+    accounting must agree exactly across pipelines."""
+    from raft_tla_tpu.engine.simulate import Simulator
+    from raft_tla_tpu.models.invariants import (build_constraint,
+                                                build_type_ok)
+    setup = load_config("configs/MCraft_bounded.cfg")
+    dims = setup.dims
+    roots = [init_state(dims)]
+    kw = dict(invariants={"TypeOK": build_type_ok(dims)},
+              constraint=build_constraint(dims, setup.bounds),
+              batch=32, depth=16, chunk=8)
+    r1 = Simulator(dims, pipeline="v1", **kw).run(roots, 512, seed=11)
+    r2 = Simulator(dims, pipeline="v2", **kw).run(roots, 512, seed=11)
+    assert (r1.steps, r1.traces, r1.violation_invariant) \
+        == (r2.steps, r2.traces, r2.violation_invariant)
