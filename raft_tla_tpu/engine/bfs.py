@@ -96,6 +96,12 @@ class EngineConfig:
     # "searchsorted" (binary-search inversion; identical outputs).  Kept
     # switchable until a TPU profile picks the winner.
     compact_method: str = "scatter"
+    # Enqueue/trace-record lowering (engine/chunk.py): "scatter" writes
+    # each compacted row at its cumsum position (+ per-lane trash for
+    # masked lanes); "window" rebuilds a K-row window at next_count with
+    # a searchsorted gather + one dynamic_update_slice.  Live rows are
+    # bit-identical; switchable until a TPU profile picks the winner.
+    enqueue_method: str = "scatter"
     # None = defer to the cfg file (make_engine fills it in); a bool from
     # the caller always wins — the documented precedence chain.
     check_deadlock: Optional[bool] = None
@@ -412,7 +418,8 @@ class BFSEngine:
             dims=dims, expand=expand, fingerprint=fingerprint,
             pack_ok=pack_ok, inv_fns=inv_fns, constraint=constraint,
             B=B, G=G, K=K, Q=Q, TQ=TQ, record_static=record_static,
-            compactor=compactor, insert_fn=fpset.insert, v2=self._v2)
+            compactor=compactor, insert_fn=fpset.insert, v2=self._v2,
+            enqueue_method=cfg.enqueue_method)
 
         def chunk(qcur, cur_count, offset0, qnext, next_count, seen,
                   tbuf, tcount0, max_steps):

@@ -44,6 +44,19 @@ def choose_k(B: int, G: int, requested=None) -> int:
     return min(fpset._pow2(max(k, G, B)), fpset._pow2(B * G))
 
 
+def inv_positions(mask, out_len: int):
+    """Invert a boolean mask's compaction map: result[k] = index of the
+    (k+1)-th True lane, for k < sum(mask); clipped in-range otherwise
+    (callers gate dead slots themselves).  The searchsorted(side="left")
+    over the running count with +1 queries is the subtle core shared by
+    the searchsorted compactor and the window enqueue/trace lowerings —
+    keep it in ONE place."""
+    cum = jnp.cumsum(mask.astype(_I32))
+    q = jnp.arange(1, out_len + 1, dtype=_I32)
+    return jnp.clip(jnp.searchsorted(cum, q, side="left"),
+                    0, mask.shape[0] - 1).astype(_I32)
+
+
 def build_compactor(B: int, G: int, K: int, reduce_p=None,
                     method: str = "scatter"):
     """Returns ``compact(en) -> (P, total, lane_id, kvalid)`` for a
@@ -102,10 +115,7 @@ def build_compactor(B: int, G: int, K: int, reduce_p=None,
 
     def compact_searchsorted(en):
         P, total, enf, kvalid = _prefix(en)
-        cumf = jnp.cumsum(enf.astype(_I32))                 # [BG]
-        found = jnp.searchsorted(cumf, jnp.arange(1, K + 1, dtype=_I32),
-                                 side="left").astype(_I32)
-        lane_id = jnp.where(kvalid, jnp.clip(found, 0, BG - 1), kspread)
+        lane_id = jnp.where(kvalid, inv_positions(enf, K), kspread)
         return P, total, lane_id, kvalid
 
     if method == "scatter":

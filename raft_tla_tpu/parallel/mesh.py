@@ -223,7 +223,8 @@ class MeshBFSEngine:
             dims=dims, expand=expand, fingerprint=fingerprint,
             pack_ok=pack_ok, inv_fns=inv_fns, constraint=constraint,
             B=B, G=G, K=K, Q=QL, TQ=TQ, record_static=record_static,
-            compactor=compactor, insert_fn=route_insert, v2=self._v2)
+            compactor=compactor, insert_fn=route_insert, v2=self._v2,
+            enqueue_method=cfg.enqueue_method)
 
         def sharded_chunk(qcur, cur_counts, offset0, qnext, next_counts,
                           shi, slo, ssize, tbuf, tcount0, max_steps):

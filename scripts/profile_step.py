@@ -241,6 +241,34 @@ def main():
     print(f"{'CHUNK v2 x8 (8 batches per call)':42s} "
           f"{(time.time() - t0) / n / 8 * 1e3:9.2f} ms/batch")
 
+    # Full candidate config: v2 + searchsorted compaction + window
+    # enqueue — the three profile-justified lowerings together.
+    eng3 = make_engine(setup, EngineConfig(
+        batch=B, queue_capacity=1 << 20, seen_capacity=1 << 23,
+        record_trace=False, check_deadlock=False, pipeline="v2",
+        compact_method="searchsorted", enqueue_method="window"))
+    qnext3 = jnp.zeros((QA, SW), jnp.uint8)
+    seen3 = fpset.empty(cfg.seen_capacity)
+    tbuf3 = tuple(jnp.zeros((eng3._TA,), d) for d in
+                  (jnp.uint32, jnp.uint32, jnp.uint32, jnp.uint32,
+                   jnp.int32))
+
+    def chunk8_v3(qnext, seen, tbuf, nb):
+        return eng3._chunk(qcur, jnp.int32(nb * B), jnp.int32(0), qnext,
+                           jnp.int32(0), seen, tbuf, jnp.int32(0),
+                           jnp.int32(nb))
+
+    out3 = chunk8_v3(qnext3, seen3, tbuf3, 1)
+    jax.block_until_ready(out3)
+    out3 = chunk8_v3(out3[0], out3[1], out3[2], 8)
+    jax.block_until_ready(out3)
+    t0 = time.time()
+    for _ in range(n):
+        out3 = chunk8_v3(out3[0], out3[1], out3[2], 8)
+    jax.block_until_ready(out3)
+    print(f"{'CHUNK v2+ss+win x8 (full candidate)':42s} "
+          f"{(time.time() - t0) / n / 8 * 1e3:9.2f} ms/batch")
+
 
 if __name__ == "__main__":
     main()
