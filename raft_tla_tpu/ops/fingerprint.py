@@ -28,14 +28,15 @@ Hardening history (2026-07-31): the original design summed RAW products
 where structured state differences can cancel linearly, so it was
 replaced with the per-element avalanche above as a matter of hygiene.
 Measurement note: a 63M-state engine run (MCraft_bounded level 13) found
-63,312,389 distinct vs the oracle's 63,312,437 — a 48-state deficit that
-is IDENTICAL under both hash designs (artifacts/mcraft_L13_engine.txt
-and _v2.txt), which RULES OUT fingerprint collisions as its cause (two
-independent hash families cannot collide on the same 48 pairs).  Every
-level <= 12 and the full generated count (186,182,136) match the oracle
-exactly; the deficit is deterministic and hash-independent — a
-representational question (canonical-encoding alias or a rare
-candidate-path edge) tracked as the top open item in ROUND4_NOTES.md.
+63,312,389 distinct vs the then-oracle count of 63,312,437 — a 48-state
+"deficit" IDENTICAL under both hash designs (artifacts/
+mcraft_L13_engine.txt and _v2.txt), which ruled out fingerprint
+collisions.  RESOLVED by the dual-key sweep + pair capture
+(scripts/row_dedup_sweep.py, ROUND5_NOTES.md): all 48 pairs are
+spec-IDENTICAL states that the oracle-side pickle digest split because
+``pickle.dumps`` is sensitive to object-identity sharing (memo
+backreferences).  **The engine's 63,312,389 is the true count — exact
+parity through level 13**; oracle_exhaust.py now hashes memo-free.
 
 The all-ones pair is reserved as the FPSet's empty/pad sentinel; real
 fingerprints landing on it are remapped deterministically.

@@ -35,11 +35,27 @@ from raft_tla_tpu.models.pystate import init_state
 from raft_tla_tpu.utils.cfg import load_config
 
 
+def _fresh(x):
+    """Deep-rebuild nested tuples so no container object occurs twice."""
+    return tuple(_fresh(e) for e in x) if isinstance(x, tuple) else x
+
+
 def canon_digest(s) -> bytes:
     canon = (s.current_term, s.role, s.voted_for, s.log, s.commit_index,
              s.votes_responded, s.votes_granted, s.next_index,
              s.match_index, tuple(sorted(s.messages)))
-    return blake2b(pickle.dumps(canon, protocol=5), digest_size=16).digest()
+    # Memoization-free bytes: plain ``pickle.dumps`` emits a 2-byte memo
+    # backreference when a container object appears twice (e.g. an RVR
+    # response's mlog IS the sender's log tuple on one action path, but
+    # an equal copy on another), so byte-equality depended on object
+    # IDENTITY, not value — which split 48 spec-identical states at
+    # MCraft_bounded level 13 into 96 digests (the infamous "48-state
+    # engine deficit" of ROUND4_NOTES: the ENGINE was right, this digest
+    # overcounted).  ``_fresh`` rebuilds every container, so nothing is
+    # ever memoized (ints/bools are pickled inline, containers are all
+    # new objects) and the bytes are a pure function of the VALUE.
+    return blake2b(pickle.dumps(_fresh(canon), protocol=5),
+                   digest_size=16).digest()
 
 
 def main():

@@ -46,7 +46,11 @@ from raft_tla_tpu.utils.cfg import load_config
 
 
 def canon_digest(s) -> bytes:
-    """Spec-side digest — BYTE-IDENTICAL to oracle_exhaust.canon_digest."""
+    """Spec-side digest as oracle_exhaust.canon_digest had it BEFORE the
+    memoization fix — kept memo-SENSITIVE deliberately: this sweep's job
+    was to demonstrate that this digest splits value-equal states (it
+    does: 48 pairs at L13, every pair PyState-==; see ROUND5_NOTES.md).
+    oracle_exhaust.py now hashes with Pickler.fast (memo-free)."""
     canon = (s.current_term, s.role, s.voted_for, s.log, s.commit_index,
              s.votes_responded, s.votes_granted, s.next_index,
              s.match_index, tuple(sorted(s.messages)))

@@ -181,3 +181,38 @@ def test_bounded_bfs_is_finite_and_stable():
                    check_deadlock=False, max_levels=4)
     assert (res.distinct_states, res.diameter) == (res2.distinct_states,
                                                    res2.diameter)
+
+
+def test_exhaust_digest_is_object_identity_insensitive():
+    """scripts/oracle_exhaust.canon_digest must hash VALUES, not object
+    graphs: two ==-equal states whose internals differ only in tuple
+    sharing (an RVR's mlog being the sender's log tuple vs an equal
+    copy) must digest identically.  Plain pickle.dumps emits a memo
+    backreference for the shared case — that identity-sensitivity split
+    48 spec-identical states at MCraft_bounded L13 into 96 digests (the
+    'engine 48-state deficit' that wasn't: ROUND5_NOTES.md)."""
+    import os
+    import sys
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__),
+                                    os.pardir, "scripts"))
+    from oracle_exhaust import canon_digest
+    from raft_tla_tpu.models.dims import RVR, RaftDims
+    from raft_tla_tpu.models.pystate import init_state
+
+    dims = RaftDims(n_servers=2, n_values=1, max_log=2, n_msg_slots=4)
+    s = init_state(dims)
+    log0 = ((1, 1),)
+    # A value-equal copy built at runtime: a LITERAL ((1, 1),) would be
+    # constant-folded by CPython to the same object as log0, silently
+    # recreating the sharing this test must break.
+    log0_copy = tuple((e[0], e[1]) for e in log0)
+    assert log0 == log0_copy and log0 is not log0_copy
+    assert log0[0] is not log0_copy[0]
+    shared = s.replace(
+        log=(log0, ()),
+        messages=frozenset({((RVR, 0, 1, 1, 1, log0), 1)}))
+    fresh = s.replace(
+        log=(log0, ()),
+        messages=frozenset({((RVR, 0, 1, 1, 1, log0_copy), 1)}))
+    assert shared == fresh
+    assert canon_digest(shared) == canon_digest(fresh)
