@@ -527,7 +527,7 @@ class BFSEngine:
             # Only now reject unpackable roots (see schema.check_packable:
             # an invariant-flagged root is a violation, not an error).
             for e in encoded:
-                check_packable(e)
+                check_packable(e, self.dims)
             rows_np = np.stack([flatten_state(e, dims) for e in encoded])
             # Root fingerprints for the trace store — computed (and their
             # program compiled) BEFORE the duration clock starts; root

@@ -187,7 +187,7 @@ class Simulator:
             res.wall_seconds = time.time() - t0
             return None
         for e in encoded:
-            check_packable(e)
+            check_packable(e, self.dims)
         return np.stack([flatten_state(e, dims) for e in encoded])
 
     def run(self, roots: List[PyState], num_steps: int, seed: int = 0,

@@ -90,6 +90,18 @@ class RaftDims:
 
     # -- derived widths ----------------------------------------------------
     @property
+    def value_bytes(self) -> int:
+        """Bytes per log-entry VALUE in the packed uint8 row (schema.py).
+        Base spec: 1 (values are interned client codes 1..V <= 255).
+        Variants whose values exceed 255 — models/reconfig.py's
+        configuration entries at CFG_BASE + masks — override this to 2;
+        flatten/unflatten then carry high-byte planes for the log value
+        lanes and the message columns that hold values (AEReq entry
+        value, RVResp mlog values), appended at the END of the row so
+        the base layout is unchanged."""
+        return 1
+
+    @property
     def payload_width(self) -> int:
         return max(6, 2 + 2 * self.max_log)
 

@@ -96,11 +96,28 @@ class ReconfigDims(RaftDims):
         full = (1 << self.n_servers) - 1
         if not self.targets:
             raise ValueError("ReconfigDims needs at least one target config")
+        if self.n_servers > 7:
+            # joint_value(old, new) = CFG_BASE + (old << 8) + new must fit
+            # the 2-byte value lanes (value_bytes below): with 8-bit masks
+            # the joint encoding needs 17 bits, so cap membership at 7.
+            raise ValueError("ReconfigDims supports at most 7 servers "
+                             "(2-byte log-value packing)")
         for c in self.targets:
             if not (1 <= c <= full):
                 raise ValueError(
                     f"target config {c:#x} not a nonempty subset of the "
                     f"{self.n_servers} servers")
+
+    @property
+    def value_bytes(self) -> int:
+        """Configuration entries (CFG_BASE + (old << 8) + new <= 36,735
+        for n <= 7) exceed uint8: the packed row carries value high
+        bytes.  Without this, config entries WRAP mod 256 in the queue
+        rows — old<<8 and CFG_BASE are multiples of 256, so a joint or
+        final entry silently aliases to the client value ``new_mask``,
+        corrupting every state past a leader's first InitiateReconfig
+        (caught 2026-07-31 by a leader-seeded depth-2 differential)."""
+        return 2
 
     # -- grid -------------------------------------------------------------
     @property

@@ -84,7 +84,7 @@ def decode_message(w: np.ndarray, dims: RaftDims) -> tuple:
     return (3, src, dst, mterm, int(w[4]), int(w[5]))
 
 
-def check_packable(st: "StateBatch") -> None:
+def check_packable(st: "StateBatch", dims: "RaftDims") -> None:
     """Raise if any field value cannot round-trip the uint8 row packing.
 
     Host-side, roots only; kernel-produced successors are guarded by
@@ -92,23 +92,41 @@ def check_packable(st: "StateBatch") -> None:
     invariant check, so a root that an invariant would flag (e.g.
     matchIndex = -1 under TypeOK) is reported as the violation it is; this
     guard only rejects roots that would otherwise alias silently.  ``msg``
-    column 4 — the one sign-extended field — admits [-128, 127]; every
-    other value is unsigned [0, 255]."""
+    column 4 — the one sign-extended field — admits [-128, 127]; value
+    lanes (log values; msg value columns) admit [0, 65535] when
+    ``dims.value_bytes == 2`` (reconfiguration entries); every other
+    value is unsigned [0, 255]."""
+    vb = dims.value_bytes
+    vmax = 255 if vb == 1 else 65535
     for name, arr in zip(StateBatch._fields, st):
         a = np.asarray(arr)
         if a.size == 0:
             continue
         if name == "msg":
             col4 = a[..., 4]
-            rest = np.delete(a, 4, axis=-1)
+            vcols = () if vb == 1 else _msg_value_cols(dims)
+            skip = (4,) + tuple(vcols)
+            rest = np.delete(a, skip, axis=-1)
+            vals = a[..., list(vcols)] if vcols else np.zeros(1)
             if ((col4 < -128).any() or (col4 > 127).any()
                     or (rest.size and ((rest < 0).any()
-                                       or (rest > 255).any()))):
+                                       or (rest > 255).any()))
+                    or (vcols and ((vals < 0).any()
+                                   or (vals > vmax).any()))):
                 raise ValueError(
                     "state field 'msg' has value outside the packable "
-                    "range (column 4: [-128, 127]; others: [0, 255]): "
+                    "range (column 4: [-128, 127]; value columns: "
+                    f"[0, {vmax}]; others: [0, 255]): observed "
                     f"col4 [{int(col4.min())}, {int(col4.max())}], "
-                    f"rest [{int(rest.min())}, {int(rest.max())}]")
+                    f"others [{int(rest.min())}, {int(rest.max())}]"
+                    + (f", value cols [{int(vals.min())}, "
+                       f"{int(vals.max())}]" if vcols else ""))
+        elif name == "log_val":
+            if int(a.min()) < 0 or int(a.max()) > vmax:
+                raise ValueError(
+                    f"state field 'log_val' has value outside the "
+                    f"packable range [0, {vmax}]: min={int(a.min())}, "
+                    f"max={int(a.max())}")
         elif int(a.min()) < 0 or int(a.max()) > 255:
             raise ValueError(
                 f"state field {name!r} has value outside the packable "
@@ -192,10 +210,24 @@ def decode_state(st: StateBatch, dims: RaftDims) -> PyState:
 ROW_DTYPE = np.uint8
 
 
+def _msg_value_cols(dims: RaftDims):
+    """Message-row columns that carry log-entry VALUES (dims.py layout):
+    the AEReq entry value at 8 and the RVResp mlog value lanes at
+    [6+L, 6+2L) — deduplicated (they overlap at L == 2, where column 8
+    is both the AEReq entry value and an mlog value lane)."""
+    L = dims.max_log
+    return tuple(sorted({8, *range(6 + L, 6 + 2 * L)}))
+
+
 def state_width(dims: RaftDims) -> int:
     n, L, M, W = (dims.n_servers, dims.max_log, dims.n_msg_slots,
                   dims.msg_width)
-    return n * 7 + 2 * n * L + 2 * n * n + M * W + M
+    base = n * 7 + 2 * n * L + 2 * n * n + M * W + M
+    if dims.value_bytes == 2:
+        # High-byte planes for log values [N,L] and the message value
+        # columns [M, L+1], appended after the base layout.
+        base += n * L + M * len(_msg_value_cols(dims))
+    return base
 
 
 def build_pack_guard(dims: RaftDims):
@@ -206,6 +238,19 @@ def build_pack_guard(dims: RaftDims):
     negation into their overflow mask, so wrap-around is a hard error,
     never silent state aliasing."""
     import jax.numpy as jnp
+
+    if dims.value_bytes == 2:
+        vcols = jnp.asarray(_msg_value_cols(dims))
+
+        def pack_ok(st: StateBatch):
+            return (jnp.all(st.term <= 255)
+                    & jnp.all(st.msg_cnt <= 255)
+                    & jnp.all(st.msg[:, 3] <= 255)
+                    & jnp.all(st.msg[:, 4] <= 127)
+                    & jnp.all(st.log_val <= 65535)
+                    & jnp.all(st.msg[:, vcols] <= 65535))
+
+        return pack_ok
 
     def pack_ok(st: StateBatch):
         # Column 4 is sign-extended on decode (mprevLogIndex for AEReq, but
@@ -222,11 +267,18 @@ def build_pack_guard(dims: RaftDims):
 def flatten_state(st: StateBatch, dims: RaftDims):
     """StateBatch (single state) -> [state_width] uint8 row.  Works under
     vmap for batches.  Import-free of jax: uses the array namespace of its
-    inputs (numpy or jnp)."""
+    inputs (numpy or jnp).  Under ``dims.value_bytes == 2`` the row ends
+    with high-byte planes for the value-carrying lanes (log values, AEReq
+    entry value, RVResp mlog values) so variant values up to 65535 —
+    reconfiguration entries — survive the uint8 packing."""
     parts = [st.term, st.role, st.voted_for, st.log_term.reshape(-1),
              st.log_val.reshape(-1), st.log_len, st.commit, st.votes_resp,
              st.votes_gran, st.next_idx.reshape(-1),
              st.match_idx.reshape(-1), st.msg.reshape(-1), st.msg_cnt]
+    if dims.value_bytes == 2:
+        cols = list(_msg_value_cols(dims))
+        parts.append((st.log_val.reshape(-1) >> 8))
+        parts.append((st.msg[:, cols] >> 8).reshape(-1))
     if isinstance(st.term, np.ndarray):
         return np.concatenate([np.asarray(p, np.int32).reshape(-1)
                                for p in parts]).astype(ROW_DTYPE)
@@ -237,7 +289,9 @@ def flatten_state(st: StateBatch, dims: RaftDims):
 def unflatten_state(row, dims: RaftDims) -> StateBatch:
     """[state_width] uint8 row -> StateBatch (int32 fields).  Works under
     vmap.  Tolerates int32 input rows (pre-packing callers) — the signed
-    fix-up below is a no-op for values already < 128."""
+    fix-up below is a no-op for values already < 128, and the value
+    high-byte reassembly (value_bytes == 2) is likewise a no-op for rows
+    whose high planes are zero."""
     n, L, M, W = (dims.n_servers, dims.max_log, dims.n_msg_slots,
                   dims.msg_width)
     if isinstance(row, np.ndarray):
@@ -256,5 +310,27 @@ def unflatten_state(row, dims: RaftDims) -> StateBatch:
     # that can be negative; stored two's-complement in the uint8 row).
     msg = out[11]
     col4 = (xp.arange(W) == 4)[None, :]
-    out[11] = xp.where(col4 & (msg >= 128), msg - 256, msg)
+    msg = xp.where(col4 & (msg >= 128), msg - 256, msg)
+    if dims.value_bytes == 2:
+        cols = list(_msg_value_cols(dims))
+        lv_hi = row[off:off + n * L].reshape((n, L))
+        off += n * L
+        mv_hi = row[off:off + M * len(cols)].reshape((M, len(cols)))
+        # Reassemble value = (low byte of the base lane) + (high plane
+        # << 8).  Masking the base lane to its low byte keeps this a
+        # no-op for int32 pre-packing rows, whose base lane carries the
+        # full value AND whose high plane carries the same bits.
+        vmask = np.zeros((W,), bool)
+        vmask[cols] = True
+        if isinstance(row, np.ndarray):
+            full_hi = np.zeros((M, W), np.int32)
+            full_hi[:, cols] = mv_hi
+        else:
+            full_hi = xp.zeros((M, W), xp.int32)
+            for k, c in enumerate(cols):
+                full_hi = full_hi.at[:, c].set(mv_hi[:, k])
+            vmask = xp.asarray(vmask)
+        msg = xp.where(vmask[None, :], (msg & 0xFF) + (full_hi << 8), msg)
+        out[4] = (out[4] & 0xFF) + (lv_hi << 8)
+    out[11] = msg
     return StateBatch(*out)

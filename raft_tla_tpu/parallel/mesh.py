@@ -558,7 +558,7 @@ class MeshBFSEngine:
                     res.wall_seconds = time.time() - t_enter
                     return res
             for e in encoded:       # reject silently-aliasing roots
-                check_packable(e)
+                check_packable(e, self.dims)
             rows_np = np.stack([flatten_state(e, dims) for e in encoded])
             if cfg.record_trace:
                 rhi, rlo = (np.asarray(x) for x in

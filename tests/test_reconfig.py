@@ -252,3 +252,45 @@ def test_mesh_engine_matches_single_on_reconfig3():
     assert got.levels == want.levels
     assert got.generated == want.generated
     assert got.violation is None
+
+
+def test_engine_matches_oracle_from_leader_roots_deep():
+    """Config entries only exist once a leader runs InitiateReconfig, and
+    no leader exists within the shallow from-Init diameters the other
+    end-to-end tests use — so they never packed a configuration value.
+    Seed leader-holding roots and go deep enough that joint entries are
+    appended, replicated through AppendEntries messages, and re-expanded
+    from packed queue rows: this caught the uint8 value-wrap bug
+    (CFG_BASE + (old << 8) + new === new_mask mod 256, silently aliasing
+    a joint entry to a client value; fixed by dims.value_bytes == 2
+    high-byte planes in the packed row)."""
+    import os
+    import sys
+
+    from raft_tla_tpu.engine.bfs import BFSEngine, EngineConfig
+    from raft_tla_tpu.models.invariants import (build_constraint,
+                                                constraint_py)
+    from raft_tla_tpu.utils.cfg import load_config
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__),
+                                    os.pardir, "scripts"))
+    from leader_bench import leader_states
+
+    here = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    setup = load_config(os.path.join(here, "configs/reconfig3.cfg"))
+    dims, bounds = setup.dims, setup.bounds
+    seeds = leader_states(dims, bounds, 0)
+    assert seeds, "leader seeding failed"
+    # Depth 4 from a fresh leader covers: InitiateReconfig (level 1),
+    # AppendEntries carrying the joint entry (level 2), the follower
+    # appending it (level 3), and expansions of all of those (level 4).
+    ores = orc.bfs(seeds, dims, constraint=constraint_py(bounds),
+                   check_deadlock=False, max_levels=4)
+    eng = BFSEngine(dims, constraint=build_constraint(dims, bounds),
+                    config=EngineConfig(batch=128, queue_capacity=1 << 14,
+                                        seen_capacity=1 << 17,
+                                        record_trace=False,
+                                        check_deadlock=False,
+                                        max_diameter=4))
+    res = eng.run(seeds)
+    assert res.distinct == ores.distinct_states == 3733
+    assert res.levels[:5] == ores.levels[:5]
