@@ -65,6 +65,16 @@ BENCH_SECONDS=60 timeout 900 python bench.py \
     2> artifacts/bench_tpu.log | tee artifacts/bench_tpu.json \
     || echo "bench stage failed (rc=$?)"
 
+echo "== 2b. bench at B=8192 (batch-scaling probe, 60 s) =="
+if probe; then
+    BENCH_SECONDS=60 BENCH_BATCH=8192 BENCH_ORACLE_SECONDS=1 \
+        timeout 900 python bench.py \
+        2> artifacts/bench_tpu_b8192.log | tee artifacts/bench_tpu_b8192.json \
+        || echo "bench b8192 failed (rc=$?)"
+else
+    echo "skipped: tunnel dead"
+fi
+
 echo "== 3. leader-rich bench (60 s) =="
 if probe; then
     timeout 900 python scripts/leader_bench.py 60 \
