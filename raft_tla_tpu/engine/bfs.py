@@ -253,10 +253,13 @@ def _resolve_pipeline(requested: str, dims):
     if requested == "v1":
         return None
     if requested == "v2":
-        return build_v2(dims)       # raises on extra_families variants
+        return build_v2(dims)   # raises if a variant lacks v2 kernels
     if requested != "auto":
         raise ValueError(f"pipeline must be auto/v1/v2, got {requested!r}")
-    return None if dims.extra_families else build_v2(dims)
+    try:
+        return build_v2(dims)
+    except NotImplementedError:
+        return None             # variant without build_extra_v2 -> v1
 
 
 def find_root_violation(root_check, encoded, init_states, batch_size,

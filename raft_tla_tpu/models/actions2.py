@@ -33,8 +33,12 @@ Semantics are transcribed from models/actions.py (same raft.tla citations,
 same deliberate bug replications: the AppendEntriesAlreadyDone hidden
 guard raft.tla:309+:317, UpdateTerm leaving the message in flight :378,
 one-entry truncation :323-324).  Spec variants with ``extra_families``
-(models/reconfig.py) are NOT supported here — ``build_v2`` raises and the
-engines fall back to the v1 expand path for them.
+ride the same pipeline when they implement ``dims.build_extra_v2``
+(models/reconfig.py does: masks reuse the variant's v1 kernels with the
+pack guard folded, and the extra families' deltas/successors fold into
+``lane_out`` by family id); a variant without v2 kernels makes
+``build_v2`` raise, and the engines fall back to the v1 expand path
+under ``pipeline="auto"``.
 """
 
 from __future__ import annotations
@@ -48,7 +52,7 @@ import numpy as np
 from ..ops.fingerprint import SENTINEL, fmix32
 from .dims import (AEQ, AER, CANDIDATE, FOLLOWER, LEADER, NIL, RVQ, RVR,
                    RaftDims)
-from .actions import _add1, _set1, _set2, _setrow
+from .actions import _add1, _sel, _set1, _set2, _setrow
 from .schema import StateBatch
 
 _U32 = jnp.uint32
@@ -74,11 +78,6 @@ class V2Pipeline(NamedTuple):
 
 
 def build_v2(dims: RaftDims) -> V2Pipeline:
-    if dims.extra_families:
-        raise NotImplementedError(
-            "the v2 delta pipeline supports the base raft.tla:421-430 "
-            "action alphabet only; spec variants with extra_families use "
-            "the v1 expand path")
     N, V, L, M, W = (dims.n_servers, dims.n_values, dims.max_log,
                      dims.n_msg_slots, dims.msg_width)
     quorum = dims.build_quorum()
@@ -150,6 +149,23 @@ def build_v2(dims: RaftDims) -> V2Pipeline:
         _, c_msg, seed = consts[lane]
         return fmix32(fmix32(jnp.sum(_u(mvec) * c_msg, dtype=_U32) ^ seed)
                       * _U32(0x85EBCA6B) + seed)
+
+    # Delta toolkit handed to spec variants (dims.build_extra_v2) so
+    # their extra families can contribute exact fingerprint-sum deltas.
+    import types
+    fp_helpers = types.SimpleNamespace(
+        dpos=dpos, dvec=dvec, dsum=dsum, ZD=ZD, L=L,
+        O_TERM=O_TERM, O_ROLE=O_ROLE, O_VOTED=O_VOTED, O_LT=O_LT,
+        O_LV=O_LV, O_LL=O_LL, O_CI=O_CI, O_VR=O_VR, O_VG=O_VG,
+        O_NI=O_NI, O_MI=O_MI)
+    extra_v2 = dims.build_extra_v2(fp_helpers)
+    if extra_v2 is None or len(extra_v2) != len(dims.extra_families):
+        raise NotImplementedError(
+            f"dims {type(dims).__name__} does not provide v2 kernels for "
+            "its extra families (build_extra_v2); use the v1 pipeline")
+    extra_v1 = dims.build_extra_kernels()
+    from .schema import build_pack_guard
+    pack_ok_fn = build_pack_guard(dims)
 
     def finalize(base, msum, lane):
         seed = consts[lane][2]
@@ -423,6 +439,17 @@ def build_v2(dims: RaftDims) -> V2Pipeline:
         ovf_parts.append(occ & (st.msg_cnt + 1 > 255))
         en_parts.append(occ)
         ovf_parts.append(jnp.zeros((M,), bool))
+        # Extra families: reuse the variant's v1 kernels for the guards,
+        # and fold the pack guard on their successors exactly as the v1
+        # chunk does (engine/chunk.py: ovf |= en & ~pack_ok) — enforced
+        # here generically so a future variant whose extras touch a
+        # packed-bound field cannot silently diverge between pipelines.
+        for params, kern in extra_v1:
+            in_axes = (None,) + (0,) * len(params)
+            en_e, ovf_e, succ_e = jax.vmap(kern, in_axes)(st, *params)
+            pk_e = jax.vmap(pack_ok_fn)(succ_e)
+            en_parts.append(en_e)
+            ovf_parts.append(ovf_e | (en_e & ~pk_e))
         return jnp.concatenate(en_parts), jnp.concatenate(ovf_parts)
 
     # -- per-lane delta fingerprint + sparse successor --------------------
@@ -656,6 +683,26 @@ def build_v2(dims: RaftDims) -> V2Pipeline:
             + jnp.where(do_send & sctx["ok"], d_send[1], _U32(0)) \
             + jnp.where(is_dup, d_dup[1], _U32(0))
 
+        # Extra-family lanes: on base-family lanes every *_wr gate above
+        # is False, so the base deltas are zero and the base successor is
+        # the parent — fold the variant kernels' deltas/successors in by
+        # family id.
+        db0, db1 = d_base
+        extra_folds = []
+        for e, ((params_e, _k1), lane_fn) in enumerate(
+                zip(extra_v1, extra_v2)):
+            is_e = fam == 10 + e
+            off_e, size_e = offs[10 + e], sizes[10 + e]
+            local = jnp.clip(g - off_e, 0, size_e - 1)
+            pe = tuple(arr[local] for arr in params_e)
+            dbe, dme, succ_e = lane_fn(st, *pe)
+            db0 = db0 + jnp.where(is_e, dbe[0], _U32(0))
+            db1 = db1 + jnp.where(is_e, dbe[1], _U32(0))
+            dm0 = dm0 + jnp.where(is_e, dme[0], _U32(0))
+            dm1 = dm1 + jnp.where(is_e, dme[1], _U32(0))
+            extra_folds.append((is_e, succ_e))
+        d_base = (db0, db1)
+
         hi = finalize(ph.base0 + d_base[0], ph.msum0 + dm0, 0)
         lo = finalize(ph.base1 + d_base[1], ph.msum1 + dm1, 1)
         is_sent = (hi == SENTINEL) & (lo == SENTINEL)
@@ -707,6 +754,8 @@ def build_v2(dims: RaftDims) -> V2Pipeline:
                           commit=ci_o, votes_resp=vr_o, votes_gran=vg_o,
                           next_idx=ni_o, match_idx=mi_o,
                           msg=msg_o, msg_cnt=cnt_o)
+        for is_e, succ_e in extra_folds:
+            succ = _sel(is_e, succ_e, succ)
         return hi, lo, succ
 
     return V2Pipeline(masks=masks, parent_hash=parent_hash,

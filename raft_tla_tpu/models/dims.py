@@ -161,6 +161,23 @@ class RaftDims:
         ``kernel(state, *params) -> (enabled, overflow, state')``."""
         return []
 
+    def build_extra_v2(self, fp_helpers):
+        """Delta-pipeline kernels for the extra families (models/
+        actions2.py), in ``extra_families`` order, or ``None`` if the
+        variant does not support the v2 pipeline (engines then fall back
+        to v1).  Each entry is one ``lane_fn(state, *params) ->
+        ((d_base0, d_base1), (d_msum0, d_msum1), successor)`` — the
+        fingerprint-sum deltas plus the sparsely-constructed successor
+        for ONE instance.  The parameter arrays are NOT duplicated here:
+        actions2 feeds each lane_fn the ``build_extra_kernels`` param
+        arrays of the same family (single source of truth for the grid
+        order).  ``fp_helpers`` is actions2's delta toolkit
+        (dpos/dvec/dsum/offsets...).  Masks and the pack guard come for
+        free from ``build_extra_kernels`` (actions2 evaluates the v1
+        kernel's guards and folds ``enabled & ~pack_ok(successor)``
+        exactly as the v1 chunk does).  Base spec: no extras."""
+        return []
+
     def extra_successors_py(self, s):
         """Oracle-side successors for the extra families: iterable of
         ((family_code, params), successor_state)."""

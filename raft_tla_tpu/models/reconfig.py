@@ -168,27 +168,32 @@ class ReconfigDims(RaftDims):
         return (maj(old) and maj(new)) if old else maj(new)
 
     # -- new actions ------------------------------------------------------
+    def _append_entry(self, st, i, val):
+        """Shared log-append used by BOTH pipelines' extra kernels:
+        (fits, successor) for appending ``(term[i], val)`` to log[i]."""
+        import jax.numpy as jnp
+
+        from .actions import _add1, _set2
+        L = self.max_log
+        ln = st.log_len[i]
+        kpos = jnp.clip(ln, 0, L - 1)
+        return ln < L, st._replace(
+            log_term=_set2(st.log_term, i, kpos, st.term[i]),
+            log_val=_set2(st.log_val, i, kpos, val),
+            log_len=_add1(st.log_len, i, 1))
+
     def build_extra_kernels(self):
         import jax.numpy as jnp
 
         config_scan = _build_config_scan(self)
-        N, L = self.n_servers, self.max_log
+        N = self.n_servers
         i32 = jnp.int32
-
-        def append_entry(st, i, val):
-            from .actions import _add1, _set2
-            ln = st.log_len[i]
-            kpos = jnp.clip(ln, 0, L - 1)
-            return ln < L, st._replace(
-                log_term=_set2(st.log_term, i, kpos, st.term[i]),
-                log_val=_set2(st.log_val, i, kpos, val),
-                log_len=_add1(st.log_len, i, 1))
 
         def initiate(st, i, c):
             """Leader with a final config appends C_current,c."""
             old, new, _idx = config_scan(st, i)
             en = (st.role[i] == LEADER) & (old == 0) & (c != new)
-            fits, new_st = append_entry(
+            fits, new_st = self._append_entry(
                 st, i, CFG_BASE + (new << 8) + c)
             return en & fits, en & ~fits, new_st
 
@@ -197,7 +202,7 @@ class ReconfigDims(RaftDims):
             C_new."""
             old, new, idx = config_scan(st, i)
             en = (st.role[i] == LEADER) & (old > 0) & (st.commit[i] >= idx)
-            fits, new_st = append_entry(st, i, CFG_BASE + new)
+            fits, new_st = self._append_entry(st, i, CFG_BASE + new)
             return en & fits, en & ~fits, new_st
 
         targets = jnp.asarray(self.targets, i32)
@@ -206,6 +211,41 @@ class ReconfigDims(RaftDims):
         cc = jnp.tile(targets, N)
         servers = jnp.arange(N, dtype=i32)
         return [((ii, cc), initiate), ((servers,), finalize)]
+
+    def build_extra_v2(self, fp):
+        """Delta-pipeline kernels (models/actions2.py contract: one
+        lane_fn per extra family; param arrays come from
+        ``build_extra_kernels``): both extra actions append ONE log entry
+        at (i, Len(log[i])) — the same footprint as ClientRequest — so
+        the fingerprint delta is three ordered-position shifts and the
+        bag is untouched.  The successor comes from the SAME
+        ``_append_entry`` the v1 kernels use (no drift between
+        pipelines)."""
+        import jax.numpy as jnp
+
+        config_scan = _build_config_scan(self)
+        L = self.max_log
+
+        def append_delta_succ(st, i, val):
+            ln = st.log_len[i]
+            k = jnp.clip(ln, 0, L - 1)
+            d_base = fp.dsum(
+                fp.dpos(fp.O_LT + i * L + k, st.log_term[i, k],
+                        st.term[i]),
+                fp.dpos(fp.O_LV + i * L + k, st.log_val[i, k], val),
+                fp.dpos(fp.O_LL + i, ln, ln + 1))
+            _fits, succ = self._append_entry(st, i, val)
+            return d_base, fp.ZD, succ
+
+        def initiate(st, i, c):
+            _old, new, _idx = config_scan(st, i)
+            return append_delta_succ(st, i, CFG_BASE + (new << 8) + c)
+
+        def finalize(st, i):
+            _old, new, _idx = config_scan(st, i)
+            return append_delta_succ(st, i, CFG_BASE + new)
+
+        return [initiate, finalize]
 
     def extra_successors_py(self, s):
         n = self.n_servers

@@ -128,11 +128,72 @@ def test_v2_matches_v1_on_leader_and_pack_edge_states(rig):
         _assert_state_matches(rig, s, ctx=f"corner[{i}]")
 
 
-def test_v2_rejects_variant_dims():
-    from raft_tla_tpu.models.reconfig import ReconfigDims
+def test_v2_rejects_unsupported_variant_dims():
+    """A variant that declares extra families without v2 kernels must be
+    rejected loudly (engines then fall back to v1 under 'auto')."""
+    from raft_tla_tpu.models.dims import RaftDims
+
+    class NoV2Dims(RaftDims):
+        @property
+        def extra_families(self):
+            return (("Mystery", 2),)
+
     with pytest.raises(NotImplementedError):
-        build_v2(ReconfigDims(n_servers=2, n_values=1, max_log=2,
-                              n_msg_slots=8, targets=(0b11,)))
+        build_v2(NoV2Dims(n_servers=2, n_values=1, max_log=2,
+                          n_msg_slots=8))
+
+
+def test_v2_matches_v1_on_reconfig_variant():
+    """The joint-consensus variant through the delta pipeline: bit-equal
+    enabled/overflow/fingerprints/successors on leader states carrying
+    real configuration entries (InitiateReconfig/FinalizeReconfig lanes
+    included)."""
+    import os
+    import sys
+
+    from raft_tla_tpu.models.invariants import constraint_py
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__),
+                                    os.pardir, "scripts"))
+    from leader_bench import leader_states
+
+    setup = load_config("configs/reconfig3.cfg")
+    dims, bounds = setup.dims, setup.bounds
+    expand = build_expand(dims)
+    fp = build_fingerprint(dims)
+    pack_ok = build_pack_guard(dims)
+    v2 = build_v2(dims)
+    G = dims.n_instances
+
+    @jax.jit
+    def v1_all(st):
+        cands, en, ovf = expand(st)
+        pk = jax.vmap(pack_ok)(cands)
+        h, l = jax.vmap(fp)(cands)
+        return cands, en, ovf | (en & ~pk), h, l
+
+    @jax.jit
+    def v2_all(st):
+        en, ovf = v2.masks(st)
+        ph = v2.parent_hash(st)
+        h, l, succ = jax.vmap(v2.lane_out, (None, None, 0))(
+            st, ph, jnp.arange(G, dtype=jnp.int32))
+        phi, plo = v2.parent_fp(ph)
+        return succ, en, ovf, h, l, phi, plo
+
+    rig_ = (setup, dims, jax.jit(fp), v1_all, v2_all)
+    seeds = leader_states(dims, bounds, 0)
+    assert seeds
+    # grow a few levels so InitiateReconfig fires and its config entries
+    # replicate; states WITH config entries must be among the parents
+    res = orc.bfs(seeds, dims, constraint=constraint_py(bounds),
+                  check_deadlock=False, max_levels=3)
+    from raft_tla_tpu.models.reconfig import CFG_BASE
+    states = list(res.parent)
+    with_cfg = [s for s in states
+                if any(e[1] >= CFG_BASE for lg in s.log for e in lg)]
+    assert len(with_cfg) >= 10, "no config-entry states generated"
+    for i, s in enumerate(with_cfg[:40] + states[:60]):
+        _assert_state_matches(rig_, s, ctx=f"reconfig[{i}]")
 
 
 def test_compactor_methods_identical():
