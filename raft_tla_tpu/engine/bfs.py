@@ -156,6 +156,9 @@ class EngineResult:
     # duration clock, recorded as evidence for up-front SEEN_CAPACITY
     # sizing (each is a rehash + retrace on the growing engine).
     growth_stalls: List = dataclasses.field(default_factory=list)
+    # Which successor pipeline actually ran ("v1"/"v2") — makes an
+    # ``auto`` fallback observable instead of a silent slowdown.
+    pipeline: str = ""
 
     @property
     def states_per_second(self) -> float:
@@ -248,8 +251,14 @@ def _auto_capacities(sw: int, batch: int,
 
 
 def _resolve_pipeline(requested: str, dims):
-    """EngineConfig.pipeline -> a v2 pipeline object or None (v1)."""
-    from ..models.actions2 import build_v2
+    """EngineConfig.pipeline -> a v2 pipeline object or None (v1).
+
+    Under ``auto``, only :class:`~..models.actions2.V2Unavailable` (the
+    variant genuinely lacks v2 kernels) selects v1 — any other error from
+    kernel construction propagates, so a bug in a variant's
+    ``build_extra_v2`` can never silently degrade to the slow path.  The
+    resolved choice is recorded on ``EngineResult.pipeline``."""
+    from ..models.actions2 import V2Unavailable, build_v2
     if requested == "v1":
         return None
     if requested == "v2":
@@ -258,7 +267,7 @@ def _resolve_pipeline(requested: str, dims):
         raise ValueError(f"pipeline must be auto/v1/v2, got {requested!r}")
     try:
         return build_v2(dims)
-    except NotImplementedError:
+    except V2Unavailable:
         return None             # variant without build_extra_v2 -> v1
 
 
@@ -291,6 +300,10 @@ class BFSEngine:
         self.dims = dims
         self.config = config or EngineConfig()
         cfg = self.config
+        if cfg.checkpoint_dir:
+            # Fail at construction, not at the first level-boundary write.
+            from . import checkpoint as _ckpt
+            _ckpt.check_dims_checkpointable(dims)
         self.inv_names = list((invariants or {}).keys())
         inv_fns = list((invariants or {}).values())
         expand = build_expand(dims)
@@ -505,7 +518,7 @@ class BFSEngine:
                     f"checkpoint dims {resume.dims} != engine dims {dims}")
         elif init_states is None:
             raise ValueError("need init_states or resume")
-        res = EngineResult()
+        res = EngineResult(pipeline="v2" if self._v2 is not None else "v1")
         self._growth_stalls = res.growth_stalls
         t_enter = time.time()   # for early returns before the budget clock
         # Trace recording off => plain dict store (never written); avoids

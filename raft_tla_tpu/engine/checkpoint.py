@@ -39,7 +39,34 @@ from ..models.pystate import PyState
 # 2026-07-31) — v2 snapshots' seen-keys and trace fingerprints are keyed
 # by the old hash; resuming them would re-count explored states as new,
 # so they are rejected rather than silently mis-resumed.
-FORMAT_VERSION = 3
+# v4: metadata carries the dims *class* and the packed row width.  v3
+# restore rebuilt every checkpoint as base RaftDims, so a ReconfigDims
+# snapshot could not round-trip (TypeError on its 'targets' key), and the
+# variant's 2-byte value lanes changed state_width with no version signal
+# — a stale variant snapshot would have died with an opaque shape error.
+# v3 base-dims files still load; v3 *variant* files (written before the
+# class was recorded) are rejected with a clear message rather than
+# guessed at.
+FORMAT_VERSION = 4
+
+# Restorable dims classes.  An allowlist, not pickle: checkpoint metadata
+# is JSON and the class name in it must map to a known, audited schema.
+def _dims_registry():
+    from ..models.reconfig import ReconfigDims
+    return {"RaftDims": RaftDims, "ReconfigDims": ReconfigDims}
+
+
+def check_dims_checkpointable(dims) -> None:
+    """Raise at engine CONSTRUCTION time if ``dims`` could not be saved —
+    otherwise the TypeError would first fire at the level-boundary
+    snapshot write, after a full level of expansion work is already
+    done and about to be lost."""
+    name = type(dims).__name__
+    if name not in _dims_registry():
+        raise TypeError(
+            f"dims class {name!r} is not checkpoint-restorable; add it "
+            "to engine/checkpoint._dims_registry or run without "
+            "checkpoint_dir")
 
 
 @dataclasses.dataclass
@@ -67,8 +94,13 @@ class Checkpoint:
 
 def save(path: str, ckpt: Checkpoint) -> None:
     """Atomically write ``ckpt`` to ``path`` (a ``.npz`` file)."""
+    from ..models.schema import state_width
+    check_dims_checkpointable(ckpt.dims)
+    cls_name = type(ckpt.dims).__name__
     meta = {
         "version": FORMAT_VERSION,
+        "dims_class": cls_name,
+        "state_width": state_width(ckpt.dims),
         "dims": dataclasses.asdict(ckpt.dims),
         "distinct": ckpt.distinct,
         "generated": ckpt.generated,
@@ -166,11 +198,40 @@ def load(path: str) -> Checkpoint:
 def _load_one(path: str) -> Checkpoint:
     with np.load(path) as z:
         meta = json.loads(bytes(z["meta"]).decode())
-        if meta["version"] != FORMAT_VERSION:
+        if meta["version"] not in (3, FORMAT_VERSION):
             raise ValueError(
                 f"checkpoint format v{meta['version']} != v{FORMAT_VERSION}")
+        # v3 snapshots predate dims_class; a v3 file carrying variant-only
+        # keys (e.g. 'targets') cannot be restored to the right class with
+        # confidence, so it is rejected rather than guessed at.
+        cls_name = meta.get("dims_class")
+        if cls_name is None:
+            if set(meta["dims"]) - set(
+                    f.name for f in dataclasses.fields(RaftDims)):
+                raise ValueError(
+                    "v3 checkpoint was written by a dims VARIANT (extra "
+                    f"dims keys {sorted(set(meta['dims']))}); v3 metadata "
+                    "does not record the class — re-run the variant from "
+                    "scratch to produce a v4 snapshot")
+            cls_name = "RaftDims"
+        registry = _dims_registry()
+        if cls_name not in registry:
+            raise ValueError(
+                f"checkpoint dims class {cls_name!r} is not in this "
+                f"build's registry ({sorted(registry)}); it was written "
+                "by a build with more dims variants")
+        cls = registry[cls_name]
+        dims = cls(**{k: tuple(v) if isinstance(v, list) else v
+                      for k, v in meta["dims"].items()})
+        if "state_width" in meta:
+            from ..models.schema import state_width
+            if state_width(dims) != meta["state_width"]:
+                raise ValueError(
+                    f"checkpoint row width {meta['state_width']} != "
+                    f"{state_width(dims)} for {cls.__name__}: the packed "
+                    "layout changed since this snapshot was written")
         return Checkpoint(
-            dims=RaftDims(**meta["dims"]),
+            dims=dims,
             frontier=z["frontier"],
             seen_hi=z["seen_hi"],
             seen_lo=z["seen_lo"],

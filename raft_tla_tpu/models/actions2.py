@@ -34,10 +34,14 @@ same deliberate bug replications: the AppendEntriesAlreadyDone hidden
 guard raft.tla:309+:317, UpdateTerm leaving the message in flight :378,
 one-entry truncation :323-324).  Spec variants with ``extra_families``
 ride the same pipeline when they implement ``dims.build_extra_v2``
-(models/reconfig.py does: masks reuse the variant's v1 kernels with the
-pack guard folded, and the extra families' deltas/successors fold into
-``lane_out`` by family id); a variant without v2 kernels makes
-``build_v2`` raise, and the engines fall back to the v1 expand path
+(models/reconfig.py does), and the extra families' deltas/successors fold
+into ``lane_out`` by family id.  Extra-family MASKS come from the
+variant's guards-only ``build_extra_masks_v2`` kernels when provided
+(one ``pack_ok(parent)`` per parent, no per-lane successors); absent
+that, the masks pass falls back to running the variant's full v1 kernels
+with ``enabled & ~pack_ok(successor)`` folded, exactly as the v1 chunk
+does.  A variant without v2 kernels makes ``build_v2`` raise
+:class:`V2Unavailable`, and the engines fall back to the v1 expand path
 under ``pipeline="auto"``.
 """
 
@@ -57,6 +61,15 @@ from .schema import StateBatch
 
 _U32 = jnp.uint32
 _I32 = jnp.int32
+
+
+class V2Unavailable(NotImplementedError):
+    """This dims variant has no v2 kernels (no/partial ``build_extra_v2``).
+
+    A dedicated type so ``pipeline="auto"`` resolution can fall back to v1
+    on exactly this condition — an *accidental* NotImplementedError deep in
+    a variant's kernel construction must propagate, not silently select
+    the slow path (advisor r4 finding)."""
 
 
 class ParentHash(NamedTuple):
@@ -160,10 +173,15 @@ def build_v2(dims: RaftDims) -> V2Pipeline:
         O_NI=O_NI, O_MI=O_MI)
     extra_v2 = dims.build_extra_v2(fp_helpers)
     if extra_v2 is None or len(extra_v2) != len(dims.extra_families):
-        raise NotImplementedError(
+        raise V2Unavailable(
             f"dims {type(dims).__name__} does not provide v2 kernels for "
             "its extra families (build_extra_v2); use the v1 pipeline")
     extra_v1 = dims.build_extra_kernels()
+    extra_masks = dims.build_extra_masks_v2()
+    if extra_masks is not None and len(extra_masks) != len(extra_v1):
+        raise ValueError(
+            f"{type(dims).__name__}.build_extra_masks_v2 returned "
+            f"{len(extra_masks)} kernels for {len(extra_v1)} extra families")
     from .schema import build_pack_guard
     pack_ok_fn = build_pack_guard(dims)
 
@@ -439,17 +457,30 @@ def build_v2(dims: RaftDims) -> V2Pipeline:
         ovf_parts.append(occ & (st.msg_cnt + 1 > 255))
         en_parts.append(occ)
         ovf_parts.append(jnp.zeros((M,), bool))
-        # Extra families: reuse the variant's v1 kernels for the guards,
-        # and fold the pack guard on their successors exactly as the v1
-        # chunk does (engine/chunk.py: ovf |= en & ~pack_ok) — enforced
-        # here generically so a future variant whose extras touch a
-        # packed-bound field cannot silently diverge between pipelines.
-        for params, kern in extra_v1:
-            in_axes = (None,) + (0,) * len(params)
-            en_e, ovf_e, succ_e = jax.vmap(kern, in_axes)(st, *params)
-            pk_e = jax.vmap(pack_ok_fn)(succ_e)
-            en_parts.append(en_e)
-            ovf_parts.append(ovf_e | (en_e & ~pk_e))
+        # Extra families: guards-only mask kernels when the variant
+        # provides them (dims.build_extra_masks_v2 — one pack_ok over the
+        # PARENT, no per-lane successor construction, preserving the
+        # guards-only design of this pass); otherwise fall back to the
+        # variant's full v1 kernels with the pack guard folded on their
+        # successors exactly as the v1 chunk does (engine/chunk.py:
+        # ovf |= en & ~pack_ok) — enforced generically so a future
+        # variant whose extras touch a packed-bound field cannot
+        # silently diverge between pipelines.
+        if extra_masks is not None and extra_v1:
+            pk_parent = pack_ok_fn(st)
+            for (params, _kern), mask_fn in zip(extra_v1, extra_masks):
+                in_axes = (None, None) + (0,) * len(params)
+                en_e, ovf_e = jax.vmap(mask_fn, in_axes)(
+                    st, pk_parent, *params)
+                en_parts.append(en_e)
+                ovf_parts.append(ovf_e)
+        else:
+            for params, kern in extra_v1:
+                in_axes = (None,) + (0,) * len(params)
+                en_e, ovf_e, succ_e = jax.vmap(kern, in_axes)(st, *params)
+                pk_e = jax.vmap(pack_ok_fn)(succ_e)
+                en_parts.append(en_e)
+                ovf_parts.append(ovf_e | (en_e & ~pk_e))
         return jnp.concatenate(en_parts), jnp.concatenate(ovf_parts)
 
     # -- per-lane delta fingerprint + sparse successor --------------------

@@ -178,6 +178,20 @@ class RaftDims:
         exactly as the v1 chunk does).  Base spec: no extras."""
         return []
 
+    def build_extra_masks_v2(self):
+        """OPTIONAL guards-only mask kernels for the extra families, in
+        ``extra_families`` order, or ``None`` to have the v2 masks pass
+        fall back to running the family's full v1 kernel (complete
+        successor construction + whole-state pack guard) per lane.  Each
+        entry is ``mask_fn(state, pack_ok_parent, *params) -> (enabled,
+        overflow)`` and MUST be bit-identical to the v1 evaluation
+        ``(en, ovf | (en & ~pack_ok(successor)))`` — actions2
+        property-tests this.  ``pack_ok_parent`` is ``pack_ok(state)``
+        evaluated ONCE per parent so footprints whose written values fit
+        their lanes by construction can reuse it instead of re-checking
+        the whole successor.  Base spec: no extras."""
+        return None
+
     def extra_successors_py(self, s):
         """Oracle-side successors for the extra families: iterable of
         ((family_code, params), successor_state)."""

@@ -55,7 +55,9 @@ from .dims import LEADER, RaftDims
 
 # Log-entry values >= CFG_BASE are configuration entries; below are client
 # values.  Layout: CFG_BASE + (old_mask << 8) + new_mask, old_mask == 0
-# meaning a final (non-joint) configuration.  Masks fit 8 bits (N <= 8).
+# meaning a final (non-joint) configuration.  Masks fit 7 bits (N <= 7,
+# enforced by ReconfigDims.__post_init__) so the joint encoding fits the
+# 2-byte packed value lanes.
 CFG_BASE = 1 << 12
 
 A_INITRECONFIG = 10
@@ -182,27 +184,44 @@ class ReconfigDims(RaftDims):
             log_val=_set2(st.log_val, i, kpos, val),
             log_len=_add1(st.log_len, i, 1))
 
-    def build_extra_kernels(self):
-        import jax.numpy as jnp
-
+    def _build_guards(self):
+        """Shared (enabled, appended-value) closures for the two extra
+        actions — the ONE source of the guard expressions, used by all
+        three kernel builders (v1 kernels, v2 lanes, v2 guards-only
+        masks) so the pipelines cannot drift."""
         config_scan = _build_config_scan(self)
-        N = self.n_servers
-        i32 = jnp.int32
 
         def initiate(st, i, c):
             """Leader with a final config appends C_current,c."""
             old, new, _idx = config_scan(st, i)
             en = (st.role[i] == LEADER) & (old == 0) & (c != new)
-            fits, new_st = self._append_entry(
-                st, i, CFG_BASE + (new << 8) + c)
-            return en & fits, en & ~fits, new_st
+            return en, CFG_BASE + (new << 8) + c
 
         def finalize(st, i):
             """Leader whose committed joint config C_old,new appends
             C_new."""
             old, new, idx = config_scan(st, i)
-            en = (st.role[i] == LEADER) & (old > 0) & (st.commit[i] >= idx)
-            fits, new_st = self._append_entry(st, i, CFG_BASE + new)
+            en = ((st.role[i] == LEADER) & (old > 0)
+                  & (st.commit[i] >= idx))
+            return en, CFG_BASE + new
+
+        return initiate, finalize
+
+    def build_extra_kernels(self):
+        import jax.numpy as jnp
+
+        init_g, fin_g = self._build_guards()
+        N = self.n_servers
+        i32 = jnp.int32
+
+        def initiate(st, i, c):
+            en, val = init_g(st, i, c)
+            fits, new_st = self._append_entry(st, i, val)
+            return en & fits, en & ~fits, new_st
+
+        def finalize(st, i):
+            en, val = fin_g(st, i)
+            fits, new_st = self._append_entry(st, i, val)
             return en & fits, en & ~fits, new_st
 
         targets = jnp.asarray(self.targets, i32)
@@ -223,7 +242,7 @@ class ReconfigDims(RaftDims):
         pipelines)."""
         import jax.numpy as jnp
 
-        config_scan = _build_config_scan(self)
+        init_g, fin_g = self._build_guards()
         L = self.max_log
 
         def append_delta_succ(st, i, val):
@@ -238,12 +257,39 @@ class ReconfigDims(RaftDims):
             return d_base, fp.ZD, succ
 
         def initiate(st, i, c):
-            _old, new, _idx = config_scan(st, i)
-            return append_delta_succ(st, i, CFG_BASE + (new << 8) + c)
+            _en, val = init_g(st, i, c)
+            return append_delta_succ(st, i, val)
 
         def finalize(st, i):
-            _old, new, _idx = config_scan(st, i)
-            return append_delta_succ(st, i, CFG_BASE + new)
+            _en, val = fin_g(st, i)
+            return append_delta_succ(st, i, val)
+
+        return [initiate, finalize]
+
+    def build_extra_masks_v2(self):
+        """Guards-only masks (dims.build_extra_masks_v2 contract): both
+        extras append one log entry whose written fields always fit their
+        lanes — the value is <= CFG_BASE + (127 << 8) + 127 = 36,735
+        against 2-byte value lanes, the entry term is ``term[i]`` which
+        the whole-state pack guard already bounds, and ``log_len`` is
+        capped by ``max_log`` — so ``pack_ok(successor) ==
+        pack_ok(parent)`` exactly and the per-lane successor + pack-guard
+        evaluation of the v1 fallback is pure overhead.  Bit-identity
+        with that fallback is property-tested (tests/test_actions2.py)."""
+        init_g, fin_g = self._build_guards()
+        L = self.max_log
+
+        def _append_masks(en, st, i, pk_parent):
+            fits = st.log_len[i] < L
+            return en & fits, (en & ~fits) | (en & fits & ~pk_parent)
+
+        def initiate(st, pk_parent, i, c):
+            en, _val = init_g(st, i, c)
+            return _append_masks(en, st, i, pk_parent)
+
+        def finalize(st, pk_parent, i):
+            en, _val = fin_g(st, i)
+            return _append_masks(en, st, i, pk_parent)
 
         return [initiate, finalize]
 

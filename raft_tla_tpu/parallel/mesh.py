@@ -82,6 +82,10 @@ class MeshBFSEngine:
         self.dims = dims
         self.config = config or EngineConfig()
         cfg = self.config
+        if cfg.checkpoint_dir:
+            # Fail at construction, not at the first level-boundary write.
+            from ..engine import checkpoint as _ckpt
+            _ckpt.check_dims_checkpointable(dims)
         devices = devices if devices is not None else jax.devices()
         self.n_dev = n = len(devices)
         self.mesh = Mesh(np.asarray(devices), ("x",))
@@ -489,7 +493,7 @@ class MeshBFSEngine:
         has_queue_budget = any(c == "queue" for c, _t in cfg.exit_conditions)
         pool_sum = (mh.build_sum(self.mesh)
                     if mp and has_queue_budget else None)
-        res = EngineResult()
+        res = EngineResult(pipeline="v2" if self._v2 is not None else "v1")
         self._growth_stalls = res.growth_stalls
         t_enter = time.time()
         trace = make_trace_store() if cfg.record_trace else TraceStore()

@@ -36,16 +36,45 @@ def neutralize_axon_if_cpu_requested() -> None:
         force_cpu()
 
 
+def _host_fingerprint() -> str:
+    """A short digest of this host's CPU identity (model + ISA feature
+    flags).  XLA's persistent cache keys entries by program, not by the
+    host CPU's feature set, so a cache populated on one machine can hand
+    a different machine code using unsupported instructions — the
+    BENCH_r04 stderr carried XLA's own warning that this "could lead to
+    execution errors such as SIGILL".  Keying the cache *directory* by
+    host identity makes cross-host reuse structurally impossible."""
+    import hashlib
+    import platform as _platform
+
+    parts = [_platform.machine()]
+    try:
+        with open("/proc/cpuinfo") as f:
+            for line in f:
+                if line.startswith(("model name", "flags", "Features")):
+                    parts.append(line.strip())
+                    if len(parts) >= 3:
+                        break
+    except OSError:
+        parts.append(_platform.processor())
+    return hashlib.sha256("|".join(parts).encode()).hexdigest()[:12]
+
+
 def enable_persistent_cache() -> None:
     """Point jax at the repo-local persistent compilation cache.  The BFS
     chunk program takes ~1 min (TPU) to minutes (CPU) to compile; with the
     cache, every CLI/bench/driver invocation after the first is instant.
-    Safe to call multiple times, before or after backend init."""
+    Safe to call multiple times, before or after backend init.
+
+    The cache lives under a per-host subdirectory (see
+    :func:`_host_fingerprint`) so a cache written by a different machine
+    — e.g. a CI host with a wider AVX feature set than the TPU-tunnel
+    host — can never be loaded here and SIGILL a bench mid-window."""
     import jax
 
     cache = os.path.join(
         os.path.dirname(os.path.dirname(os.path.dirname(
-            os.path.abspath(__file__)))), ".jax_cache")
+            os.path.abspath(__file__)))), ".jax_cache", _host_fingerprint())
     try:
         jax.config.update("jax_compilation_cache_dir", cache)
         jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)

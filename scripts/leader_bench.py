@@ -86,6 +86,12 @@ def main():
     # small budget would stop before any expansion.  A batch-sized seed
     # set is still leader-rich, and the TPU-sized invocation (batch 2048)
     # ingests every seed anyway.
+    # COMPARABILITY: this truncation makes the measured frontier a
+    # function of ``batch`` — numbers taken at different batch sizes are
+    # different workloads, not the same bench at another setting.  The
+    # record therefore carries both ``seeds`` and ``seeds_total``; compare
+    # rows across rounds only at equal (batch, seeds) (advisor r4).
+    seeds_total = len(seeds)
     seeds = seeds[:batch]
 
     common = dict(batch=batch, queue_capacity=1 << 22,
@@ -110,7 +116,8 @@ def main():
         "metric": "leader_rich_distinct_per_s",
         "value": round(res.states_per_second, 1),
         "unit": "distinct states/s",
-        "seeds": len(seeds), "seed_build_s": round(seed_s, 1),
+        "seeds": len(seeds), "seeds_total": seeds_total,
+        "seed_build_s": round(seed_s, 1),
         "distinct": res.distinct, "generated": res.generated,
         "diameter": res.diameter, "wall_s": round(res.wall_seconds, 2),
         "stop_reason": res.stop_reason,

@@ -30,10 +30,8 @@ force_cpu()
 
 # Persistent compilation cache: the expand/step programs take tens of
 # seconds to compile on this single-core CPU; caching makes re-runs cheap.
-_cache = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
-                      ".jax_cache")
-try:
-    jax.config.update("jax_compilation_cache_dir", _cache)
-    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
-except Exception:
-    pass
+# Shared with every tool/script via the per-host-keyed helper (a cache
+# written by a different machine must never be loaded — SIGILL hazard).
+from raft_tla_tpu.utils.platform import enable_persistent_cache  # noqa: E402
+
+enable_persistent_cache()

@@ -195,6 +195,75 @@ def test_v2_matches_v1_on_reconfig_variant():
     for i, s in enumerate(with_cfg[:40] + states[:60]):
         _assert_state_matches(rig_, s, ctx=f"reconfig[{i}]")
 
+    # Pack-edge parents: the guards-only extra masks reuse
+    # pack_ok(parent) (reconfig.build_extra_masks_v2), so the ~pack_ok
+    # branch of the EXTRA lanes' overflow must match the v1 evaluation
+    # (en & ~pack_ok(successor)) even on unpackable parents.  Engine
+    # parents are always packable (they come from uint8 rows) and the
+    # core v2 masks rely on that, so only the extra lanes are compared
+    # here; force the edge by pushing a term past the uint8 bound.
+    n_extra = sum(size for _name, size in dims.extra_families)
+    lo = dims.n_instances - n_extra
+    for i, s in enumerate(with_cfg[:6]):
+        edge = s.replace(current_term=(256,) + s.current_term[1:])
+        st = jax.tree.map(jnp.asarray, encode_state(edge, dims))
+        _c1, en1, ovf1, _h1, _l1 = v1_all(st)
+        _c2, en2, ovf2, _h2, _l2, _p, _q = v2_all(st)
+        assert (np.asarray(en1)[lo:] == np.asarray(en2)[lo:]).all(), \
+            f"pack-edge[{i}] extra enabled"
+        assert (np.asarray(ovf1)[lo:] == np.asarray(ovf2)[lo:]).all(), \
+            f"pack-edge[{i}] extra overflow"
+
+
+def test_extra_masks_v2_shape_mismatch_rejected():
+    """A variant whose build_extra_masks_v2 disagrees with its family
+    count must fail at build time, not silently mis-zip kernels."""
+    from raft_tla_tpu.models.reconfig import ReconfigDims
+
+    class BadMasks(ReconfigDims):
+        def build_extra_masks_v2(self):
+            return super().build_extra_masks_v2()[:1]
+
+    setup = load_config("configs/reconfig3.cfg")
+    d = setup.dims
+    with pytest.raises(ValueError, match="build_extra_masks_v2"):
+        build_v2(BadMasks(n_servers=d.n_servers, n_values=d.n_values,
+                          max_log=d.max_log, n_msg_slots=d.n_msg_slots,
+                          targets=d.targets))
+
+
+def test_auto_pipeline_propagates_accidental_errors():
+    """pipeline='auto' falls back to v1 ONLY on V2Unavailable (the
+    dedicated no-v2-kernels signal); an accidental NotImplementedError
+    deep inside a variant's build_extra_v2 must propagate, not silently
+    select the slow path (advisor r4).  The resolved pipeline is
+    recorded on EngineResult so fallbacks are observable."""
+    from raft_tla_tpu.engine.bfs import _resolve_pipeline
+    from raft_tla_tpu.models.actions2 import V2Unavailable
+    from raft_tla_tpu.models.dims import RaftDims
+
+    base = RaftDims(n_servers=2, n_values=1, max_log=2, n_msg_slots=8)
+    assert _resolve_pipeline("auto", base) is not None   # base dims -> v2
+
+    class NoV2(RaftDims):
+        @property
+        def extra_families(self):
+            return (("Mystery", 2),)
+
+    nov2 = NoV2(n_servers=2, n_values=1, max_log=2, n_msg_slots=8)
+    with pytest.raises(V2Unavailable):
+        build_v2(nov2)
+    assert _resolve_pipeline("auto", nov2) is None       # clean fallback
+
+    class Buggy(RaftDims):
+        def build_extra_v2(self, fp_helpers):
+            raise NotImplementedError("accidental: unfinished kernel")
+
+    with pytest.raises(NotImplementedError, match="accidental"):
+        _resolve_pipeline("auto",
+                          Buggy(n_servers=2, n_values=1, max_log=2,
+                                n_msg_slots=8))
+
 
 def test_compactor_methods_identical():
     """ops/compact.py: the searchsorted lowering must produce the exact

@@ -89,6 +89,77 @@ def test_checkpoint_roundtrip_and_dims_guard(tmp_path):
         other.run(resume=path)
 
 
+def test_checkpoint_restores_dims_subclass(tmp_path):
+    """A ReconfigDims run's snapshot must round-trip to ReconfigDims —
+    v3 restore rebuilt every checkpoint as base RaftDims, so the variant
+    (different row width: 2-byte value lanes) could not resume at all
+    (advisor r4).  The resumed run must agree exactly with an
+    uninterrupted one."""
+    from raft_tla_tpu.models.reconfig import ReconfigDims
+    from raft_tla_tpu.utils.cfg import load_config
+
+    setup = load_config("configs/reconfig3.cfg")
+    dims, bounds = setup.dims, setup.bounds
+    assert isinstance(dims, ReconfigDims)
+    common = dict(batch=128, queue_capacity=1 << 12,
+                  seen_capacity=1 << 15, check_deadlock=False)
+
+    full = BFSEngine(dims, constraint=build_constraint(dims, bounds),
+                     config=EngineConfig(max_diameter=4, **common))
+    rf = full.run([init_state(dims)])
+
+    ckdir = str(tmp_path / "states")
+    eng1 = BFSEngine(dims, constraint=build_constraint(dims, bounds),
+                     config=EngineConfig(max_diameter=2,
+                                         checkpoint_dir=ckdir, **common))
+    eng1.run([init_state(dims)])
+    path = ckpt_mod.latest(ckdir)
+    ck = ckpt_mod.load(path)
+    assert type(ck.dims) is ReconfigDims
+    assert ck.dims == dims          # targets tuple survives the JSON trip
+
+    eng2 = BFSEngine(dims, constraint=build_constraint(dims, bounds),
+                     config=EngineConfig(max_diameter=4, **common))
+    r2 = eng2.run(resume=path)
+    assert (r2.distinct, r2.diameter, tuple(r2.levels)) \
+        == (rf.distinct, rf.diameter, tuple(rf.levels))
+
+
+def test_unregistered_dims_rejected_at_construction(tmp_path):
+    """With checkpoint_dir set, an un-restorable dims class must be
+    rejected when the ENGINE is built — not at the first level-boundary
+    write, after a level of expansion is done and about to be lost."""
+    class CustomDims(RaftDims):
+        pass
+
+    with pytest.raises(TypeError, match="CustomDims"):
+        BFSEngine(CustomDims(n_servers=2, n_values=1, max_log=2,
+                             n_msg_slots=8),
+                  config=EngineConfig(batch=8, queue_capacity=1 << 8,
+                                      seen_capacity=1 << 10,
+                                      checkpoint_dir=str(tmp_path / "s")))
+
+
+def test_unknown_checkpoint_dims_class_message(tmp_path):
+    """A v4 snapshot naming a dims class this build doesn't know must be
+    rejected with a diagnostic error, not a bare KeyError."""
+    import json
+
+    ckdir = str(tmp_path / "states")
+    eng = make_engine(checkpoint_dir=ckdir, max_diameter=1)
+    eng.run([init_state(DIMS)])
+    path = ckpt_mod.latest(ckdir)
+    with np.load(path) as z:
+        arrs = {k: z[k] for k in z.files}
+    meta = json.loads(bytes(arrs["meta"]).decode())
+    meta["dims_class"] = "LeaseDims"
+    arrs["meta"] = np.frombuffer(json.dumps(meta).encode(), np.uint8)
+    hacked = str(tmp_path / "level_hacked.npz")
+    np.savez_compressed(hacked, **arrs)
+    with pytest.raises(ValueError, match="LeaseDims"):
+        ckpt_mod.load(hacked)
+
+
 def test_mixed_mode_resume_guards(tmp_path):
     """A trace-off resume must not shadow trace-carrying snapshots with
     empty-trace ones in the same directory, and a trace-on resume of a
