@@ -102,6 +102,12 @@ class EngineConfig:
     # a searchsorted gather + one dynamic_update_slice.  Live rows are
     # bit-identical; switchable until a TPU profile picks the winner.
     enqueue_method: str = "scatter"
+    # FPSet insert lowering: "xla" (ops/fpset.py sort + claim protocol) or
+    # "pallas" (ops/fpset_pallas.py single sequential-grid kernel, no sort,
+    # no claims; interpret mode off-TPU).  Engine results are bit-identical
+    # (is_new contract matches); switchable until a TPU profile decides
+    # the fused-chunk question (NORTHSTAR.md §d).  Single-host engine only.
+    insert_method: str = "xla"
     # None = defer to the cfg file (make_engine fills it in); a bool from
     # the caller always wins — the documented precedence chain.
     check_deadlock: Optional[bool] = None
@@ -250,6 +256,16 @@ def _auto_capacities(sw: int, batch: int,
     return q, s
 
 
+def _resolve_insert(requested: str):
+    """EngineConfig.insert_method -> the FPSet insert function."""
+    if requested == "xla":
+        return fpset.insert
+    if requested == "pallas":
+        from ..ops import fpset_pallas
+        return fpset_pallas.insert
+    raise ValueError(f"insert_method must be xla/pallas, got {requested!r}")
+
+
 def _resolve_pipeline(requested: str, dims):
     """EngineConfig.pipeline -> a v2 pipeline object or None (v1).
 
@@ -310,6 +326,7 @@ class BFSEngine:
         fingerprint = build_fingerprint(dims)
         pack_ok = build_pack_guard(dims)
         self._v2 = _resolve_pipeline(cfg.pipeline, dims)
+        insert_fn = _resolve_insert(cfg.insert_method)
         sw = state_width(dims)
         B, G = cfg.batch, dims.n_instances
         # Compacted-candidate lanes (ops/compact.py owns the invariants).
@@ -349,7 +366,7 @@ class BFSEngine:
             k = crows.shape[0]
             cands = jax.vmap(unflatten_state, (0, None))(crows, dims)
             fph, fpl = jax.vmap(fingerprint)(cands)
-            seen, new, fail = fpset.insert(seen, fph, fpl, en)
+            seen, new, fail = insert_fn(seen, fph, fpl, en)
             n_new = jnp.sum(new, dtype=_I32)
 
             if inv_fns:
@@ -434,7 +451,7 @@ class BFSEngine:
             dims=dims, expand=expand, fingerprint=fingerprint,
             pack_ok=pack_ok, inv_fns=inv_fns, constraint=constraint,
             B=B, G=G, K=K, Q=Q, TQ=TQ, record_static=record_static,
-            compactor=compactor, insert_fn=fpset.insert, v2=self._v2,
+            compactor=compactor, insert_fn=insert_fn, v2=self._v2,
             enqueue_method=cfg.enqueue_method)
 
         def chunk(qcur, cur_count, offset0, qnext, next_count, seen,

@@ -86,6 +86,12 @@ class MeshBFSEngine:
             # Fail at construction, not at the first level-boundary write.
             from ..engine import checkpoint as _ckpt
             _ckpt.check_dims_checkpointable(dims)
+        if cfg.insert_method != "xla":
+            # The shard-local insert runs inside shard_map; the Pallas
+            # lowering is a single-host experiment (NORTHSTAR.md §d) and
+            # must not be silently ignored here.
+            raise NotImplementedError(
+                "MeshEngine supports insert_method='xla' only")
         devices = devices if devices is not None else jax.devices()
         self.n_dev = n = len(devices)
         self.mesh = Mesh(np.asarray(devices), ("x",))

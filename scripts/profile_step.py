@@ -162,6 +162,17 @@ def main():
     seen = fpset.empty(cfg.seen_capacity)
     bench("fpset.insert (K keys: sort + probes)", part_insert, seen, kh, kl,
           kvalid)
+    # Pallas sequential-grid insert (ops/fpset_pallas.py): same contract,
+    # no sort/claims; prices Mosaic scalar-DMA probing — the datum for
+    # NORTHSTAR.md §d's fused-chunk decision.  Tolerant of a Mosaic
+    # lowering failure (unmeasured until a window runs it on real TPU).
+    try:
+        from raft_tla_tpu.ops import fpset_pallas
+        seen_p = fpset.empty(cfg.seen_capacity)
+        bench("fpset_pallas.insert (sequential kernel)",
+              fpset_pallas.insert, seen_p, kh, kl, kvalid)
+    except Exception as e:  # noqa: BLE001 — report, keep profiling
+        print(f"fpset_pallas.insert                        FAILED: {e!r}")
     _, krows = bench("materialize K rows (gather+flatten)",
                      part_materialize, cflat, lane_id)
     qnext = jnp.zeros((QA, SW), jnp.uint8)

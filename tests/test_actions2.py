@@ -342,3 +342,41 @@ def test_enqueue_methods_identical_results():
         paths[meth] = [g for g, _s in trace]
     assert results["scatter"] == results["window"]
     assert paths["scatter"] == paths["window"] and len(paths["scatter"]) >= 5
+
+
+def test_insert_methods_identical_results():
+    """engine/bfs.py insert_method='pallas' (ops/fpset_pallas.py,
+    interpret mode on CPU) vs 'xla': identical distinct/generated/level
+    profile and identical replayed counterexample path — the whole
+    engine is bit-identical because the insert contract (is_new flags)
+    is."""
+    from raft_tla_tpu.engine.bfs import BFSEngine, EngineConfig
+    from raft_tla_tpu.models.invariants import build_constraint
+    setup = load_config("configs/MCraft_bounded.cfg")
+    dims = setup.dims
+    res4 = orc.bfs([init_state(dims)], dims,
+                   constraint=constraint_py(setup.bounds),
+                   check_deadlock=False, max_levels=4)
+    target = sorted(res4.parent, key=lambda s: (len(s.messages),
+                                                s.current_term))[-1]
+    fp1 = build_fingerprint(dims)
+    h, l = jax.jit(fp1)(jax.tree.map(jnp.asarray,
+                                     encode_state(target, dims)))
+    target_fp = (int(h) << 32) | int(l)
+    results, paths = {}, {}
+    for meth in ("xla", "pallas"):
+        eng = BFSEngine(
+            dims, constraint=build_constraint(dims, setup.bounds),
+            config=EngineConfig(batch=64, queue_capacity=1 << 13,
+                                seen_capacity=1 << 14, record_trace=True,
+                                check_deadlock=False, max_diameter=5,
+                                insert_method=meth))
+        res = eng.run([init_state(dims)])
+        results[meth] = (res.distinct, res.generated, res.levels,
+                         res.diameter)
+        assert res.distinct == 2300    # pinned oracle L5 cumulative
+        trace = eng.replay(target_fp)
+        assert trace and trace[-1][1] == target
+        paths[meth] = [g for g, _s in trace]
+    assert results["xla"] == results["pallas"]
+    assert paths["xla"] == paths["pallas"] and len(paths["xla"]) >= 4
