@@ -87,8 +87,31 @@ class RaftDims:
         # in int8 range: the uint8 row packing sign-extends that column.
         if not (1 <= self.max_log <= 127):
             raise ValueError("max_log must be in 1..127 (uint8 row packing)")
+        # Systematic lane-width audit (schema.audit_lane_widths): every
+        # packed field whose maximum domain value is STATIC — value lanes
+        # (incl. variant encodings like reconfig's CFG_BASE+masks), vote
+        # bitmasks, index/count lanes, message header columns — must fit
+        # its lane width, checked HERE at construction so the reconfig
+        # value-wrap bug class (a domain silently exceeding its byte
+        # width, invisible at shallow depths) can never recur in a new
+        # variant.  Lazy import: schema imports this module at top level.
+        from .schema import audit_lane_widths
+        audit_lane_widths(self)
 
     # -- derived widths ----------------------------------------------------
+    @property
+    def max_log_value(self) -> int:
+        """The largest value the spec can place in a log-entry VALUE lane
+        (and hence in the message value columns — AEReq entry value,
+        RVResp mlog values).  Base spec: client values are interned codes
+        1..|Value|.  Variants with encoded values (reconfig's
+        CFG_BASE + (old << 8) + new entries) override this; the
+        construction-time lane audit (schema.audit_lane_widths) checks it
+        against ``256**value_bytes - 1``, which is what makes a
+        too-narrow value lane a BUILD error instead of a silent wrap at
+        depth (the round-5 reconfig bug class)."""
+        return self.n_values
+
     @property
     def value_bytes(self) -> int:
         """Bytes per log-entry VALUE in the packed uint8 row (schema.py).

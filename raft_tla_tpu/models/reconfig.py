@@ -94,21 +94,33 @@ class ReconfigDims(RaftDims):
     targets: Tuple[int, ...] = ()
 
     def __post_init__(self):
-        super().__post_init__()
         full = (1 << self.n_servers) - 1
-        if not self.targets:
-            raise ValueError("ReconfigDims needs at least one target config")
         if self.n_servers > 7:
             # joint_value(old, new) = CFG_BASE + (old << 8) + new must fit
             # the 2-byte value lanes (value_bytes below): with 8-bit masks
             # the joint encoding needs 17 bits, so cap membership at 7.
+            # Checked BEFORE super().__post_init__ so this message (the
+            # rule) is what the user sees, not the generic lane audit's
+            # (which would also catch it via max_log_value > 65535).
             raise ValueError("ReconfigDims supports at most 7 servers "
                              "(2-byte log-value packing)")
+        super().__post_init__()
+        if not self.targets:
+            raise ValueError("ReconfigDims needs at least one target config")
         for c in self.targets:
             if not (1 <= c <= full):
                 raise ValueError(
                     f"target config {c:#x} not a nonempty subset of the "
                     f"{self.n_servers} servers")
+
+    @property
+    def max_log_value(self) -> int:
+        """Largest encoded value: a joint entry with both masks full —
+        CFG_BASE + (full << 8) + full <= 36,735 for n <= 7.  The lane
+        audit (schema.audit_lane_widths) checks this against the 2-byte
+        value lanes at construction."""
+        full = (1 << self.n_servers) - 1
+        return CFG_BASE + (full << 8) + full
 
     @property
     def value_bytes(self) -> int:

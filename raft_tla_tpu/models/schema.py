@@ -43,6 +43,55 @@ class StateBatch(NamedTuple):
     msg_cnt: "np.ndarray"     # [M]    bag multiplicities
 
 
+def audit_lane_widths(dims: RaftDims) -> None:
+    """Construction-time audit: every packed field whose maximum domain
+    value is STATIC must fit its lane width.  Called from
+    ``RaftDims.__post_init__`` — a too-narrow lane is a build error with
+    the field named, never a silent mod-256 wrap at depth (the reconfig
+    value-wrap bug class: ``CFG_BASE + (old << 8) + new`` aliased to the
+    plain client value the moment a state was enqueued, and no test
+    shallower than a leader's first config entry could see it).
+
+    Runtime-growing fields — terms (and the message columns that carry
+    term values: mterm at column 3, and column 4's term half), bag
+    counts — are NOT in this audit; ``build_pack_guard`` bounds those
+    per-state on device and the engines treat an overflow as a hard
+    error.  Columns 5+ carrying terms (AEReq prevLogTerm, RVResp mlog
+    entry terms) are bounded by the sender's mterm <= 255 which the
+    pack guard checks.
+    """
+    n, L = dims.n_servers, dims.max_log
+    vmax = 256 ** dims.value_bytes - 1
+    checks = (
+        # field, static max over the spec's domain, lane limit
+        ("votes_resp/votes_gran bitmask", (1 << n) - 1, 255),
+        ("voted_for (0=Nil, else server+1)", n, 255),
+        ("role", 2, 255),
+        ("log_len / commit / match_idx", L, 255),
+        ("next_idx (<= Len(log)+1)", L + 1, 255),
+        # One check covers BOTH the log value lanes and the message value
+        # columns (AEReq entry value, RVResp mlog values): flatten_state
+        # gives them identical widths (value_bytes), and their domain is
+        # the same value alphabet.
+        ("log_val / msg value columns (dims.max_log_value)",
+         dims.max_log_value, vmax),
+        ("msg column 0 (mtype+1)", 5, 255),
+        ("msg columns 1-2 (src+1, dst+1)", n, 255),
+        # Column 4 is sign-extended (mprevLogIndex reaches -1); its
+        # INDEX uses must fit int8.  (Its term uses are runtime-guarded.)
+        ("msg column 4 index uses (mprevLogIndex)", L, 127),
+        ("msg index/count columns (mlog len, nentries, mcommit)",
+         L + 1, 255),
+    )
+    for field, domain_max, limit in checks:
+        if domain_max > limit:
+            raise ValueError(
+                f"packed lane too narrow for {type(dims).__name__}: "
+                f"field {field!r} reaches {domain_max} but its lane "
+                f"holds at most {limit}; widen the lane "
+                "(dims.value_bytes for value lanes) or shrink the domain")
+
+
 def encode_message(m: tuple, dims: RaftDims) -> np.ndarray:
     """Message tuple (pystate.py layout) -> [W] int32 row (dims.py layout)."""
     w = np.zeros(dims.msg_width, np.int32)

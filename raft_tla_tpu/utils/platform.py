@@ -69,7 +69,17 @@ def enable_persistent_cache() -> None:
     The cache lives under a per-host subdirectory (see
     :func:`_host_fingerprint`) so a cache written by a different machine
     — e.g. a CI host with a wider AVX feature set than the TPU-tunnel
-    host — can never be loaded here and SIGILL a bench mid-window."""
+    host — can never be loaded here and SIGILL a bench mid-window.
+
+    KNOWN-BENIGN residual warning: XLA's CPU AOT loader may still print
+    a "Machine type used for XLA:CPU compilation doesn't match" error
+    naming ``+prefer-no-gather``/``+prefer-no-scatter`` — those are XLA
+    *tuning pseudo-features* it records at compile time but that host
+    feature detection never reports, so the message fires even when the
+    cache entry was written by THIS host in THIS session (verified
+    2026-07-31: fresh per-host dir, same process lineage).  It is a
+    false positive for the SIGILL hazard; a real cross-host entry can no
+    longer be loaded at all under the fingerprinted directory."""
     import jax
 
     cache = os.path.join(

@@ -1,0 +1,78 @@
+"""Construction-time lane-width audit (schema.audit_lane_widths).
+
+The reconfig value-wrap bug (ROUND5_NOTES: ``CFG_BASE + (old << 8) + new``
+wrapping mod 256 in the uint8 queue rows, invisible at every depth where
+no leader exists) was fixed point-wise with 2-byte value lanes; this
+audit is the bug-CLASS killer: any packed field whose static domain
+exceeds its lane width must fail at dims CONSTRUCTION with the field
+named — never reach an engine where it would alias silently.
+"""
+
+import pytest
+
+from raft_tla_tpu.models.dims import RaftDims
+from raft_tla_tpu.models.reconfig import CFG_BASE, ReconfigDims
+
+
+def test_valid_dims_pass_the_audit_across_the_domain():
+    """Every legal base/reconfig dims constructs (the audit is not
+    over-strict): sweep the corners of the constructor domain."""
+    for n in range(1, 9):
+        for v in (1, 255):
+            for L in (1, 127):
+                RaftDims(n_servers=n, n_values=v, max_log=L, n_msg_slots=4)
+    for n in range(1, 8):
+        ReconfigDims(n_servers=n, n_values=2, max_log=3, n_msg_slots=4,
+                     targets=(1,))
+
+
+def test_overflowing_value_domain_raises_at_build_with_field_named():
+    """The historical bug shape: encoded values far beyond the value
+    lane.  A variant declaring reconfig-style values but leaving
+    value_bytes at 1 (exactly the pre-fix layout) must be rejected at
+    construction, naming the value lane."""
+
+    class WrapBugDims(RaftDims):
+        # Pre-fix reconfig: joint encodings >= CFG_BASE in 1-byte lanes.
+        @property
+        def max_log_value(self):
+            full = (1 << self.n_servers) - 1
+            return CFG_BASE + (full << 8) + full
+
+    with pytest.raises(ValueError, match="log_val"):
+        WrapBugDims(n_servers=3, n_values=2, max_log=3, n_msg_slots=4)
+
+
+def test_overflowing_two_byte_lane_raises_too():
+    """Widening to 2 bytes shifts the bound, not the rule: a domain past
+    65535 must still fail at build."""
+
+    class Huge(ReconfigDims):
+        @property
+        def max_log_value(self):
+            return 1 << 17
+
+    with pytest.raises(ValueError, match="log_val"):
+        Huge(n_servers=3, n_values=2, max_log=3, n_msg_slots=4,
+             targets=(1,))
+
+
+def test_reconfig_eight_servers_rejected_with_the_rule_named():
+    """N=8 reconfig needs 17-bit joint encodings; the variant's own
+    bound (clearer than the generic audit message) fires first."""
+    with pytest.raises(ValueError, match="7 servers"):
+        ReconfigDims(n_servers=8, n_values=2, max_log=3, n_msg_slots=4,
+                     targets=(1,))
+
+
+def test_audit_is_exercised_by_construction_not_only_directly():
+    """The audit must run from __post_init__ itself (a variant author
+    gets it for free), not require an explicit call."""
+
+    class BigVals(RaftDims):
+        @property
+        def max_log_value(self):
+            return 300   # > 255 in 1-byte lanes
+
+    with pytest.raises(ValueError, match="max_log_value"):
+        BigVals(n_servers=2, n_values=2, max_log=2, n_msg_slots=4)
