@@ -126,6 +126,12 @@ class EngineConfig:
     # diameter, states/sec, queue as live counters).
     progress_interval_seconds: float = 0.0
     checkpoint_dir: Optional[str] = None  # R8: level-boundary snapshots
+    # Shared-filesystem directory for MULTI-HOST trace piece exchange
+    # (parallel/mesh.py): controllers write their per-host trace stores
+    # there and replay() merges the group.  None defers to
+    # checkpoint_dir; setting it alone gives multi-host tracing WITHOUT
+    # enabling periodic checkpoint snapshots.
+    trace_dir: Optional[str] = None
     checkpoint_every: int = 1             # snapshot every k levels...
     checkpoint_interval_seconds: float = 0.0  # ...but at most this often.
     # Snapshot cost is O(seen states), so a per-level cadence is quadratic

@@ -42,6 +42,7 @@ identical on a real TPU slice.
 
 from __future__ import annotations
 
+import os
 import time
 from functools import partial
 from typing import Callable, Dict, List, Optional
@@ -477,18 +478,25 @@ class MeshBFSEngine:
             raise ValueError("need init_states or resume")
         mp = mh.is_multiprocess()
         if mp:
-            # Multi-controller scope (parallel/multihost.py): the compiled
-            # programs and the queue/spill/growth loop below are
-            # multi-host-clean; these features still gather global state
-            # to one host and are refused loudly rather than wrong.
-            if cfg.record_trace:
+            # Multi-controller trace recording: each controller's store
+            # accumulates its own chips' records (_flush_trace) and the
+            # stores are exchanged as per-controller piece files on the
+            # shared filesystem (same R8 assumption as multi-host
+            # checkpoints), merged lazily at replay().  That exchange
+            # needs a directory every controller can see — require the
+            # checkpoint_dir rather than silently recording a trace no
+            # replay could complete.
+            if cfg.record_trace and not (cfg.trace_dir
+                                         or cfg.checkpoint_dir):
                 raise NotImplementedError(
-                    "multi-host check requires record_trace=False "
-                    "(--no-trace): the trace store is per-controller.  "
-                    "To extract a counterexample from a multi-host "
-                    "violation, pass its .state to "
-                    "engine.check.path_to_state on one host — BFS order "
-                    "makes the result a minimal-depth trace")
+                    "multi-host trace recording needs trace_dir (or "
+                    "checkpoint_dir) — a shared filesystem path, as for "
+                    "multi-host checkpoints: controllers exchange their "
+                    "trace stores as piece files there.  Alternatively "
+                    "run with record_trace=False and pass the "
+                    "violation's .state to engine.check.path_to_state "
+                    "on one host — BFS order makes the result a "
+                    "minimal-depth trace")
         # Collective agreement on host-local facts (clocks); identical-
         # everywhere decisions skip the round trip (multihost.py rule 4).
         any_flag = mh.build_any(self.mesh) if mp else None
@@ -499,6 +507,15 @@ class MeshBFSEngine:
         has_queue_budget = any(c == "queue" for c, _t in cfg.exit_conditions)
         pool_sum = (mh.build_sum(self.mesh)
                     if mp and has_queue_budget else None)
+        if mp and cfg.record_trace:
+            # Per-run piece-file id, agreed across controllers (min of
+            # local clocks): a reused trace/checkpoint directory can
+            # then never alias this run's pieces with a previous run's.
+            # int32 — the agreement primitive's width; millisecond
+            # clocks mod 2^31 collide across runs only at the same ms
+            # within a ~24-day wrap, and only in a REUSED directory.
+            self._trace_run_id = mh.build_min(self.mesh)(
+                int(time.time() * 1000) & 0x7FFFFFFF)
         res = EngineResult(pipeline="v2" if self._v2 is not None else "v1")
         self._growth_stalls = res.growth_stalls
         t_enter = time.time()
@@ -931,6 +948,12 @@ class MeshBFSEngine:
             pending, spill_next = spill_next, pending
 
         res.wall_seconds = time.time() - t0
+        if mp and cfg.record_trace:
+            # Every controller reaches this exit (stop decisions are
+            # collectively agreed), so the piece group is always
+            # complete; replay() merges the siblings on demand.
+            self._write_trace_piece(trace)
+            self._trace_merged = False
         return res
 
     # ------------------------------------------------------------------
@@ -1042,21 +1065,85 @@ class MeshBFSEngine:
             front_cleanup()
 
     def _flush_trace(self, trace, tbuf, tcount):
+        """Harvest trace records from this controller's ADDRESSABLE chip
+        buffers only (single-controller: all chips — behavior unchanged).
+        Under a process group, fetching the global arrays would be a
+        cross-host gather; instead each controller's store accumulates
+        the records its own chips produced, and the stores are merged
+        through per-controller piece files at replay time
+        (:meth:`_merge_trace_pieces`)."""
         if not self.config.record_trace:
             return
-        tc = np.asarray(tcount)
-        if not tc.any():
+        counts = self._local_counts(tcount)
+        if not any(counts.values()):
             return
-        sh, sl, ph, pl, ac = (np.asarray(x) for x in tbuf)
-        for d in range(self.n_dev):
-            m = int(tc[d])
+        comps = [sorted(x.addressable_shards,
+                        key=lambda s: s.index[0].start) for x in tbuf]
+        for shard_set in zip(*comps):
+            d = shard_set[0].index[0].start
+            m = counts.get(d, 0)
             if m == 0:
                 continue
-            fps = ((sh[d, :m].astype(np.uint64) << np.uint64(32))
-                   | sl[d, :m].astype(np.uint64))
-            parents = ((ph[d, :m].astype(np.uint64) << np.uint64(32))
-                       | pl[d, :m].astype(np.uint64))
-            trace.add_batch(fps, parents, ac[d, :m])
+            sh, sl, ph, pl, ac = (np.asarray(s.data)[0] for s in shard_set)
+            fps = ((sh[:m].astype(np.uint64) << np.uint64(32))
+                   | sl[:m].astype(np.uint64))
+            parents = ((ph[:m].astype(np.uint64) << np.uint64(32))
+                       | pl[:m].astype(np.uint64))
+            trace.add_batch(fps, parents, ac[:m])
+
+    # -- multi-host trace exchange (shared filesystem, like R8) ---------
+    @property
+    def _trace_exchange_dir(self) -> str:
+        return self.config.trace_dir or self.config.checkpoint_dir
+
+    def _trace_piece_path(self, i: int, m: int) -> str:
+        # The collectively-agreed per-run id in the name keeps a reused
+        # directory safe: without it, a controller's merge poll could
+        # match a PREVIOUS run's piece (same (dir, i, m) name) written
+        # before a slower sibling finishes fsyncing the current one, and
+        # replay would silently miss that sibling's new records.
+        return os.path.join(
+            self._trace_exchange_dir,
+            f"trace_run_{self._trace_run_id:08x}.p{i}of{m}.npz")
+
+    def _write_trace_piece(self, trace) -> None:
+        """One piece per controller, written at every run exit (all
+        controllers take the same exit — control flow is collectively
+        agreed), so the union of pieces is the global trace.  Same
+        shared-filesystem assumption as multi-host checkpoints (R8) —
+        which record_trace under a process group therefore requires
+        (``trace_dir``, defaulting to ``checkpoint_dir``)."""
+        tf, tp, ta = trace.export()
+        d = self._trace_exchange_dir
+        os.makedirs(d, exist_ok=True)
+        path = self._trace_piece_path(
+            jax.process_index(), jax.process_count())
+        tmp = path + ".tmp"
+        with open(tmp, "wb") as f:
+            np.savez_compressed(f, fps=tf, parents=tp, actions=ta)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+
+    def _merge_trace_pieces(self, timeout_s: float = 30.0) -> None:
+        """Fold every sibling controller's trace piece into this store
+        (idempotent; records are keyed by fingerprint).  Sibling files
+        appear within the skew of the collective run exit; poll briefly
+        rather than requiring an extra barrier."""
+        m = jax.process_count()
+        deadline = time.time() + timeout_s
+        for i in range(m):
+            if i == jax.process_index():
+                continue
+            path = self._trace_piece_path(i, m)
+            while not os.path.exists(path):
+                if time.time() > deadline:
+                    raise FileNotFoundError(
+                        f"trace piece {path} not written within "
+                        f"{timeout_s}s — did controller {i} exit the run?")
+                time.sleep(0.05)
+            with np.load(path) as z:
+                self.trace.add_batch(z["fps"], z["parents"], z["actions"])
 
     def _check_violation_ingest(self, res, ist, vrow, vfp) -> bool:
         """``ist``/``vrow``/``vfp`` are the ingest program's replicated
@@ -1072,7 +1159,15 @@ class MeshBFSEngine:
         res.stop_reason = "violation"
         return True
 
-    # Replay shares the single-engine mechanism.
+    # Replay shares the single-engine mechanism.  Under a process group
+    # the trace chain crosses controllers (a child inserted on this
+    # host's chips may have a parent recorded by another controller), so
+    # the sibling piece files are folded in first — once.
     def replay(self, fp: int):
         from ..engine.bfs import BFSEngine  # reuse logic via duck typing
+        from . import multihost as mh
+        if (mh.is_multiprocess() and self.config.record_trace
+                and not getattr(self, "_trace_merged", True)):
+            self._merge_trace_pieces()
+            self._trace_merged = True
         return BFSEngine.replay(self, fp)

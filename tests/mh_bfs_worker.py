@@ -36,15 +36,25 @@ def main():
     dims = RaftDims(n_servers=2, n_values=1, max_log=2, n_msg_slots=8)
     ckpt_dir = os.environ.get("MH_CKPT_DIR")
     max_dia = os.environ.get("MH_MAX_DIAMETER")
+    # MH_TRACE=1: record the trace across controllers (per-controller
+    # stores + piece-file merge at replay) and hunt a NoLeader violation
+    # whose counterexample chain crosses the process boundary.
+    trace_on = bool(os.environ.get("MH_TRACE"))
+    invariants = {"TypeOK": build_type_ok(dims)}
+    if trace_on:
+        import jax.numpy as jnp
+
+        from raft_tla_tpu.models.dims import LEADER
+        invariants["NoLeader"] = lambda st: jnp.all(st.role != LEADER)
     eng = MeshBFSEngine(
         dims,
-        invariants={"TypeOK": build_type_ok(dims)},
+        invariants=invariants,
         constraint=build_constraint(
             dims, Bounds(max_term=2, max_log_len=1, max_msg_count=1,
                          max_in_flight=1)),
         config=EngineConfig(batch=32, queue_capacity=1 << 10,
                             seen_capacity=1 << 14, check_deadlock=False,
-                            record_trace=False, sync_every=4,
+                            record_trace=trace_on, sync_every=4,
                             checkpoint_dir=ckpt_dir,
                             max_diameter=int(max_dia) if max_dia else None,
                             exit_conditions=(
@@ -60,7 +70,7 @@ def main():
         res = eng.run(None, resume=path)
     else:
         res = eng.run([init_state(dims)])
-    print(json.dumps({
+    out = {
         "process": jax.process_index(),
         "global_devices": len(jax.devices()),
         "distinct": res.distinct,
@@ -69,7 +79,13 @@ def main():
         "levels": res.levels,
         "stop_reason": res.stop_reason,
         "violation": res.violation.invariant if res.violation else None,
-    }))
+    }
+    if trace_on and res.violation is not None:
+        steps = eng.replay(res.violation.fingerprint)
+        assert steps[-1][1] == res.violation.state
+        out["trace_len"] = len(steps)
+        out["trace_path"] = [g for g, _s in steps]
+    print(json.dumps(out))
 
 
 if __name__ == "__main__":

@@ -107,6 +107,31 @@ def test_two_process_exhaustive_bfs_matches_oracle():
     assert a["generated"] == 12584
 
 
+def test_multihost_trace_records_and_replays(tmp_path):
+    """Multi-host trace recording (the one capability where multi-host
+    used to be strictly weaker than single-host): each controller's
+    store holds its own chips' records, the stores are exchanged as
+    piece files on the shared filesystem, and BOTH controllers replay
+    the SAME violation to the SAME counterexample path even though the
+    chain's links were recorded on different hosts."""
+    ck = str(tmp_path / "ck")
+    a, b = _run_pair("mh_bfs_worker.py",
+                     extra_env={"MH_TRACE": "1", "MH_CKPT_DIR": ck})
+    assert a["violation"] == b["violation"] == "NoLeader"
+    assert a["stop_reason"] == b["stop_reason"] == "violation"
+    # Identical replayed paths on both controllers, long enough to be a
+    # real election (Timeout -> RequestVote -> grant exchange ->
+    # BecomeLeader), and the piece group is on disk.
+    assert a["trace_path"] == b["trace_path"]
+    assert a["trace_len"] == b["trace_len"] >= 5
+    pieces = sorted(n for n in os.listdir(ck) if n.startswith("trace_run_"))
+    assert len(pieces) == 2
+    # One agreed run id across controllers, both pieces of the group.
+    assert pieces[0].split(".")[0] == pieces[1].split(".")[0]
+    assert pieces[0].endswith(".p0of2.npz")
+    assert pieces[1].endswith(".p1of2.npz")
+
+
 def test_multihost_checkpoint_resumes_everywhere(tmp_path):
     """Checkpoint portability across controller counts: two controllers
     write a piece group mid-run; (a) two controllers resume it to
