@@ -99,8 +99,10 @@ class EngineConfig:
     # Enqueue/trace-record lowering (engine/chunk.py): "scatter" writes
     # each compacted row at its cumsum position (+ per-lane trash for
     # masked lanes); "window" rebuilds a K-row window at next_count with
-    # a searchsorted gather + one dynamic_update_slice.  Live rows are
-    # bit-identical; switchable until a TPU profile picks the winner.
+    # a searchsorted gather + one dynamic_update_slice; "pallas" issues
+    # run-coalesced HBM-to-HBM segment DMAs (ops/enqueue_pallas.py — the
+    # contiguous-append formulation; interpret mode off-TPU).  Live rows
+    # are bit-identical; switchable until a TPU profile picks the winner.
     enqueue_method: str = "scatter"
     # FPSet insert lowering: "xla" (ops/fpset.py sort + claim protocol) or
     # "pallas" (ops/fpset_pallas.py single sequential-grid kernel, no sort,

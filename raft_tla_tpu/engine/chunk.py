@@ -55,7 +55,7 @@ def build_chunk_body(*, dims, expand, fingerprint, pack_ok, inv_fns,
     overflow masks, fingerprints, successor rows, per-family stats) —
     property-tested in tests/test_actions2.py — so the two paths share
     checkpoints and differential baselines freely."""
-    if enqueue_method not in ("scatter", "window"):
+    if enqueue_method not in ("scatter", "window", "pallas"):
         raise ValueError(f"unknown enqueue method {enqueue_method!r}")
     BG = B * G
     inv_id = build_inv_id(inv_fns) if inv_fns else None
@@ -137,6 +137,14 @@ def build_chunk_body(*, dims, expand, fingerprint, pack_ok, inv_fns,
             epos = next_count + jnp.cumsum(enq.astype(_I32)) - 1
             epos = jnp.where(enq, epos, Q + jnp.arange(K, dtype=_I32))
             qnext = qnext.at[epos].set(krows)
+        elif enqueue_method == "pallas":
+            # Run-coalesced DMA append (ops/enqueue_pallas.py): the enq
+            # destination is contiguous, so the rows go out as ~new_n/SEG
+            # HBM-to-HBM segment copies instead of K row-scatters.  Live
+            # rows bit-identical; trash region simply untouched (the
+            # "window" precedent).
+            from ..ops import enqueue_pallas
+            qnext = enqueue_pallas.enqueue(qnext, next_count, krows, enq)
         else:
             # "window": invert the placement instead of scattering 473-
             # byte rows (the TPU profile's 14.5 ms enqueue stage).  The

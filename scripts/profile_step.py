@@ -178,6 +178,16 @@ def main():
     qnext = jnp.zeros((QA, SW), jnp.uint8)
     bench("enqueue scatter (K rows)", part_enqueue, qnext, jnp.int32(0),
           krows, kvalid)
+    # Pallas run-coalesced enqueue (ops/enqueue_pallas.py): the
+    # contiguous-append formulation of the 14.5 ms scatter stage —
+    # the other half of NORTHSTAR §d's fused-chunk pricing.
+    try:
+        from raft_tla_tpu.ops import enqueue_pallas
+        qnext2 = jnp.zeros((QA, SW), jnp.uint8)
+        bench("enqueue pallas (run-coalesced DMA)", enqueue_pallas.enqueue,
+              qnext2, jnp.int32(0), krows, kvalid)
+    except Exception as e:  # noqa: BLE001 — report, keep profiling
+        print(f"enqueue_pallas                             FAILED: {e!r}")
 
     # The engine's own fused chunk program (qnext/seen/tbuf are donated:
     # thread the outputs back through).

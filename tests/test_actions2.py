@@ -325,7 +325,7 @@ def test_enqueue_methods_identical_results():
                                      encode_state(target, dims)))
     target_fp = (int(h) << 32) | int(l)
     results, paths = {}, {}
-    for meth in ("scatter", "window"):
+    for meth in ("scatter", "window", "pallas"):
         eng = BFSEngine(
             dims, constraint=build_constraint(dims, setup.bounds),
             config=EngineConfig(batch=128, queue_capacity=1 << 14,
@@ -340,8 +340,9 @@ def test_enqueue_methods_identical_results():
         trace = eng.replay(target_fp)
         assert trace and trace[-1][1] == target
         paths[meth] = [g for g, _s in trace]
-    assert results["scatter"] == results["window"]
-    assert paths["scatter"] == paths["window"] and len(paths["scatter"]) >= 5
+    assert results["scatter"] == results["window"] == results["pallas"]
+    assert paths["scatter"] == paths["window"] == paths["pallas"]
+    assert len(paths["scatter"]) >= 5
 
 
 def test_insert_methods_identical_results():

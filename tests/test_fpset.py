@@ -109,6 +109,42 @@ def test_pallas_insert_matches_xla_insert():
     assert not bool(fp.contains(s_p, hi ^ jnp.uint32(1 << 30), lo).any())
 
 
+def test_pallas_enqueue_matches_scatter_reference():
+    """ops/enqueue_pallas.py: live queue rows [0, next_count') identical
+    to the scatter lowering for adversarial masks — empty, full, single
+    lanes, runs ending at K-1, run lengths straddling the SEG quantum —
+    and the overhang never lands outside [next_count'+0, +SEG)."""
+    from raft_tla_tpu.ops import enqueue_pallas as ep
+
+    rng = np.random.RandomState(3)
+    K, SW, QA = 256, 37, 1024
+    masks = [
+        np.zeros(K, bool),
+        np.ones(K, bool),
+        np.eye(1, K, 0, dtype=bool)[0],           # single first lane
+        np.eye(1, K, K - 1, dtype=bool)[0],       # single last lane
+    ]
+    m = np.zeros(K, bool)
+    m[5:5 + ep.SEG + 3] = True                    # one run straddling SEG
+    masks.append(m)
+    for _ in range(6):
+        masks.append(rng.rand(K) < rng.choice([0.1, 0.5, 0.9]))
+    for t, mask in enumerate(masks):
+        krows = jnp.asarray(rng.randint(0, 255, (K, SW)), jnp.uint8)
+        base = jnp.asarray(rng.randint(0, 255, (QA, SW)), jnp.uint8)
+        nc = int(rng.randint(0, QA - 2 * K))
+        enq = jnp.asarray(mask)
+        got = np.asarray(ep.enqueue(base, jnp.int32(nc), krows, enq))
+        # scatter reference (chunk.py semantics, live region only)
+        want = np.asarray(base).copy()
+        want[nc:nc + int(mask.sum())] = np.asarray(krows)[mask]
+        end = nc + int(mask.sum())
+        assert (got[:end] == want[:end]).all(), f"mask {t}: live rows"
+        # overhang confined to < SEG rows past the live region
+        assert (got[end + ep.SEG:] == want[end + ep.SEG:]).all(), \
+            f"mask {t}: wrote beyond the overhang window"
+
+
 def test_pallas_insert_reports_fail_when_genuinely_full():
     s = fpset.empty(1 << 8)
     from raft_tla_tpu.ops import fpset_pallas
