@@ -68,6 +68,11 @@ def main(argv=None):
     c.add_argument("--spill-dir", default=None,
                    help="memory-map spilled level segments here (TLC's "
                         "disk-backed state queue) instead of host RAM")
+    c.add_argument("--trace-dir", default=None,
+                   help="shared-filesystem dir for MULTI-HOST trace "
+                        "piece exchange (defaults to --checkpoint-dir; "
+                        "set this alone to trace multi-host runs "
+                        "without periodic snapshots)")
     c.add_argument("--progress-seconds", type=float, default=None,
                    help="stderr progress line cadence (TLC's ~per-minute "
                         "report: generated/distinct/rate/queue); 0 "
@@ -162,6 +167,7 @@ def main(argv=None):
                 resolve(args.checkpoint_interval,
                         "CHECKPOINT_INTERVAL", 60.0)),
             spill_dir=resolve(args.spill_dir, "SPILL_DIR", None),
+            trace_dir=resolve(args.trace_dir, "TRACE_DIR", None),
             progress_interval_seconds=float(
                 resolve(args.progress_seconds, "PROGRESS_SECONDS", 60.0)))
         engine_cls = args.engine if args.engine == "auto" else None

@@ -78,7 +78,7 @@ def _tokenize(text: str) -> List[str]:
 _BACKEND_KEYS = {
     "BATCH", "QUEUE_CAPACITY", "SEEN_CAPACITY", "N_MSG_SLOTS", "MAX_LOG",
     "PLATFORM", "CHECKPOINT_DIR", "CHECKPOINT_EVERY", "CHECKPOINT_INTERVAL",
-    "SPILL_DIR", "PROGRESS_SECONDS",
+    "SPILL_DIR", "TRACE_DIR", "PROGRESS_SECONDS",
 }
 
 
