@@ -107,6 +107,17 @@ def main():
                               str(1 << 21 if on_accel else 1 << 19)))
     scap = int(os.environ.get("BENCH_SEEN_CAP",
                               str(1 << 25 if on_accel else 1 << 21)))
+    # Run-event log (obs/): the bench is also the telemetry-regression
+    # gate — after the run the file must exist and parse, else nonzero rc.
+    # The default scratch dir is cleaned up after validation (repeated
+    # CI runs must not accumulate orphans); an explicit BENCH_EVENTS_OUT
+    # is the caller's to keep.
+    import tempfile
+    events_file = os.environ.get("BENCH_EVENTS_OUT")
+    scratch_dir = None
+    if events_file is None:
+        scratch_dir = tempfile.mkdtemp(prefix="bench_obs_")
+        events_file = os.path.join(scratch_dir, "events.jsonl")
     cfg = EngineConfig(
         batch=int(os.environ.get("BENCH_BATCH",
                                  str(2048 if on_accel else 512))),
@@ -114,7 +125,8 @@ def main():
         seen_capacity=scap,
         check_deadlock=False,
         record_trace=False,          # raw engine throughput (trace store is
-        max_seconds=BENCH_SECONDS)   # host-side; C++ store tracked separately)
+        max_seconds=BENCH_SECONDS,   # host-side; C++ store tracked separately)
+        events_out=events_file)
     # "auto": on a multi-accelerator slice (e.g. v5e-8) the run shards
     # over all devices — the mesh engine is the product's scaling path
     # and the north-star target is defined on the full slice.
@@ -128,6 +140,21 @@ def main():
     rate = res.distinct / res.wall_seconds if res.wall_seconds else 0.0
     _mark(f"engine run done: {res.distinct} distinct in "
           f"{res.wall_seconds:.1f}s; starting oracle window")
+
+    # Telemetry-regression gate: a run that leaves its event log missing
+    # or malformed fails the WHOLE bench loudly — an unobservable engine
+    # is a regression even when its states/sec number looks fine.  The
+    # path is re-resolved through the engine (a process group rewrites
+    # events_out to a per-controller piece name); cleanup happens on
+    # both outcomes (obs.validate_and_cleanup).
+    from raft_tla_tpu.obs import validate_and_cleanup
+    try:
+        n_events = validate_and_cleanup(engine._events_path(), scratch_dir)
+    except (OSError, ValueError) as e:
+        print(f"bench: telemetry regression — run event log invalid: {e}",
+              file=sys.stderr)
+        sys.exit(1)
+    _mark(f"event log validated ({n_events} events)")
 
     # Python-oracle baseline on the same model (CPU, single core), over
     # the SAME wall budget from the same root — comparable windows, so the
@@ -168,6 +195,11 @@ def main():
         # Seen-set doublings as (capacity-after, off-clock stall seconds):
         # the cost evidence for sizing SEEN_CAPACITY up front.
         "growth_stalls": res.growth_stalls,
+        # Host-side per-phase wall-time breakdown (obs/ phase timers):
+        # chunk dispatch vs stats fetch vs spill vs growth — the pipeline
+        # accounting BENCH_r06+ carries so hot-path work can be targeted
+        # at the phase that actually dominates.
+        "phases": {k: round(v, 4) for k, v in res.phases.items()},
         "baseline_states_per_sec": round(base_rate, 1),
         "baseline_distinct": ores.distinct_states,
         "baseline_wall_s": round(base_wall, 2),

@@ -16,6 +16,30 @@ import os
 import sys
 
 
+def _write_metrics(path: str, registry) -> None:
+    """--metrics-out: final registry snapshot as pretty JSON.  Under a
+    process group every controller runs this at exit, so the path gets
+    the same per-controller piece suffix as event logs/checkpoints and
+    the write is atomic (tmp + rename) — two hosts must never interleave
+    into one file on the shared filesystem."""
+    import json
+    try:
+        import jax
+        pi, pc = jax.process_index(), jax.process_count()
+    except Exception:
+        pi, pc = 0, 1
+    if pc > 1:
+        root, ext = os.path.splitext(path)
+        path = f"{root}.p{pi}of{pc}{ext or '.json'}"
+    d = os.path.dirname(os.path.abspath(path))
+    os.makedirs(d, exist_ok=True)
+    tmp = f"{path}.tmp{pi}"
+    with open(tmp, "w") as f:
+        json.dump(registry.snapshot(), f, indent=2, sort_keys=True)
+        f.write("\n")
+    os.replace(tmp, path)
+
+
 def _force_platform(platform: str):
     if platform == "cpu":
         from .utils.platform import force_cpu
@@ -73,11 +97,22 @@ def main(argv=None):
                         "piece exchange (defaults to --checkpoint-dir; "
                         "set this alone to trace multi-host runs "
                         "without periodic snapshots)")
-    c.add_argument("--progress-seconds", type=float, default=None,
+    c.add_argument("--progress-interval", "--progress-seconds",
+                   dest="progress_interval", type=float, default=None,
                    help="stderr progress line cadence (TLC's ~per-minute "
                         "report: generated/distinct/rate/queue); 0 "
                         "disables; default 60 (flag > cfg PROGRESS_SECONDS "
                         "directive > default)")
+    c.add_argument("--events-out", default=None,
+                   help="JSONL run-event log (run_start / level_complete "
+                        "with per-phase timings / fpset_resize / spill / "
+                        "checkpoint / violation / run_end — see README "
+                        "Observability).  Defaults to events.jsonl next "
+                        "to --checkpoint-dir when that is set")
+    c.add_argument("--metrics-out", default=None,
+                   help="write the final metrics-registry snapshot "
+                        "(counters/gauges/histograms JSON) here after "
+                        "the run")
 
     s = sub.add_parser("simulate", help="random-trace simulation")
     common(s)
@@ -92,6 +127,9 @@ def main(argv=None):
     s.add_argument("--max-seconds", type=float, default=None,
                    help="wall-clock budget; stops cleanly before "
                         "--num-steps is reached")
+    s.add_argument("--metrics-out", default=None,
+                   help="write the final metrics-registry snapshot "
+                        "(sim phase timers + step counters JSON) here")
 
     args = p.parse_args(argv)
     platform = args.platform
@@ -168,8 +206,9 @@ def main(argv=None):
                         "CHECKPOINT_INTERVAL", 60.0)),
             spill_dir=resolve(args.spill_dir, "SPILL_DIR", None),
             trace_dir=resolve(args.trace_dir, "TRACE_DIR", None),
+            events_out=resolve(args.events_out, "EVENTS_OUT", None),
             progress_interval_seconds=float(
-                resolve(args.progress_seconds, "PROGRESS_SECONDS", 60.0)))
+                resolve(args.progress_interval, "PROGRESS_SECONDS", 60.0)))
         engine_cls = args.engine if args.engine == "auto" else None
         if args.engine == "mesh":
             from .parallel.mesh import MeshBFSEngine
@@ -193,6 +232,8 @@ def main(argv=None):
             initial_states(setup, seed=args.seed) if resume is None else None,
             resume=resume)
         print(format_result(res))
+        if args.metrics_out:
+            _write_metrics(args.metrics_out, engine.metrics)
         if res.violation is not None:
             if args.no_trace:
                 print("\nviolating state (trace recording disabled):")
@@ -233,6 +274,8 @@ def main(argv=None):
     res = sim.run(initial_states(setup, seed=args.seed),
                   num_steps=args.num_steps, seed=args.seed,
                   max_seconds=max_seconds)
+    if args.metrics_out:
+        _write_metrics(args.metrics_out, sim.metrics)
     print(f"steps visited      {res.steps}")
     print(f"traces             {res.traces}")
     print(f"wall seconds       {res.wall_seconds:.2f}")

@@ -73,7 +73,9 @@ def engine_config_from_backend(setup: CheckSetup) -> EngineConfig:
         checkpoint_interval_seconds=float(
             be.get("CHECKPOINT_INTERVAL",
                    EngineConfig.checkpoint_interval_seconds)),
-        spill_dir=be.get("SPILL_DIR"))
+        spill_dir=be.get("SPILL_DIR"),
+        trace_dir=be.get("TRACE_DIR"),
+        events_out=be.get("EVENTS_OUT"))
 
 
 def make_engine(setup: CheckSetup,

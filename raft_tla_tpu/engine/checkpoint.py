@@ -199,18 +199,24 @@ def _load_one(path: str) -> Checkpoint:
     with np.load(path) as z:
         meta = json.loads(bytes(z["meta"]).decode())
         if meta["version"] not in (3, FORMAT_VERSION):
+            # Both loadable versions in the message: "!= v4" used to send
+            # v3 holders hunting for a nonexistent problem (ADVICE r5).
             raise ValueError(
-                f"checkpoint format v{meta['version']} != v{FORMAT_VERSION}")
+                f"checkpoint format v{meta['version']} not in "
+                f"(v3, v{FORMAT_VERSION})")
         # v3 snapshots predate dims_class; a v3 file carrying variant-only
         # keys (e.g. 'targets') cannot be restored to the right class with
         # confidence, so it is rejected rather than guessed at.
         cls_name = meta.get("dims_class")
         if cls_name is None:
-            if set(meta["dims"]) - set(
-                    f.name for f in dataclasses.fields(RaftDims)):
+            extra = set(meta["dims"]) - set(
+                f.name for f in dataclasses.fields(RaftDims))
+            if extra:
+                # Only the UNEXPECTED keys: listing the full dims dict
+                # buried the one key that mattered (ADVICE r5).
                 raise ValueError(
-                    "v3 checkpoint was written by a dims VARIANT (extra "
-                    f"dims keys {sorted(set(meta['dims']))}); v3 metadata "
+                    "v3 checkpoint was written by a dims VARIANT "
+                    f"(unexpected dims keys {sorted(extra)}); v3 metadata "
                     "does not record the class — re-run the variant from "
                     "scratch to produce a v4 snapshot")
             cls_name = "RaftDims"

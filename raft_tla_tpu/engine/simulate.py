@@ -33,6 +33,7 @@ from ..models.actions import build_expand
 from ..models.dims import RaftDims
 from ..models.invariants import build_inv_id
 from ..models.pystate import PyState
+from ..obs import MetricsRegistry
 from ..models.schema import (StateBatch, build_pack_guard, check_packable,
                              decode_state, encode_state, flatten_state,
                              stack_states, state_width, unflatten_state)
@@ -148,8 +149,12 @@ class Simulator:
                  invariants: Optional[Dict[str, Callable]] = None,
                  constraint: Optional[Callable] = None,
                  batch: int = 256, depth: int = 100, chunk: int = 128,
-                 pipeline: str = "auto"):
+                 pipeline: str = "auto", metrics=None):
         self.dims = dims
+        # Same telemetry spine as the BFS engines (obs/): phase timers
+        # around the walker-advance dispatch and the latch fetch, live
+        # step/trace counters.
+        self.metrics = metrics or MetricsRegistry()
         self.inv_names = list((invariants or {}).keys())
         inv_fns = list((invariants or {}).values())
         self.batch, self.depth, self.chunk = batch, depth, chunk
@@ -213,14 +218,23 @@ class Simulator:
         abuf = jax.device_put(jnp.zeros((B, D), _I32), dev)
         res.traces = B
 
+        mt = self.metrics
         while res.steps < num_steps:
             key, sub = jax.random.split(key)
-            carry = self._chunk(rows, roots_j, tstep, cur_root, abuf, sub)
-            rows, _roots, tstep, cur_root, abuf, restarts, latch = carry
+            with mt.phase_timer("sim_chunk"):
+                carry = self._chunk(rows, roots_j, tstep, cur_root, abuf,
+                                    sub)
+                rows, _roots, tstep, cur_root, abuf, restarts, latch = carry
             res.steps += B * self.chunk
-            res.traces += int(restarts)
-            vf, vinv, vroot, vlen, vacts, vchoice = latch
-            if bool(vf):
+            # int(restarts) below is the blocking device sync of this
+            # loop — the "sim_fetch" phase is the walkers' compute time.
+            with mt.phase_timer("sim_fetch"):
+                res.traces += int(restarts)
+                vf, vinv, vroot, vlen, vacts, vchoice = latch
+                vf = bool(vf)
+            mt.counter("sim/steps", B * self.chunk)
+            mt.gauge("sim/traces", res.traces)
+            if vf:
                 self._reconstruct(res, roots, int(vinv), int(vroot),
                                   int(vlen), np.asarray(vacts),
                                   int(vchoice))

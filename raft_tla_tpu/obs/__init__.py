@@ -1,0 +1,24 @@
+"""Telemetry subsystem shared by every engine and entry point.
+
+Two halves, one spine:
+
+- :mod:`.metrics` — a zero-dep, thread-safe :class:`MetricsRegistry`
+  (counters / gauges / histograms) with a :meth:`~MetricsRegistry.phase_timer`
+  context manager wrapping the host-side phases of the BFS chunk loop
+  and the simulate/mesh paths;
+- :mod:`.events` — the structured JSONL :class:`RunEventLog`
+  (run_start, level_complete, fpset_resize, spill, checkpoint,
+  violation, deadlock, run_end) written next to the checkpoint dir and
+  per-host under ``parallel/mesh.py``.
+
+The CLI exposes them via ``--metrics-out`` / ``--events-out``, the
+checker service via the ``stats`` request, and ``bench.py`` embeds the
+final phase breakdown in its JSON.  See README.md "Observability" for
+the event schema and metric-name inventory.
+"""
+
+from .metrics import (Histogram, MetricsRegistry, PHASE_PREFIX,  # noqa: F401
+                      phase_delta)
+from .events import (REQUIRED_EVENTS, RunEventLog,               # noqa: F401
+                     device_memory_stats, events_path,
+                     validate_and_cleanup, validate_run_events)

@@ -1,0 +1,158 @@
+"""Structured run events — one JSONL line per engine lifecycle event.
+
+The event log is the durable half of the telemetry subsystem (the
+registry is the live half): engines append one JSON object per line for
+``run_start``, ``level_complete``, ``fpset_resize``, ``spill``,
+``checkpoint``, ``violation``, ``deadlock``, and ``run_end``.  Every
+event carries ``ts`` (epoch seconds) and ``elapsed_seconds`` (since the
+log was opened); level and end events add live counters, the per-phase
+wall-time breakdown, and the device memory probe.  The JSONL file is the
+supported interface for dashboards and regression tooling — the bench
+harness fails loudly when a run leaves it missing or malformed
+(``validate_run_events``).
+
+Placement: ``EngineConfig.events_out`` names the file; when unset it
+defaults to ``events.jsonl`` next to the checkpoint dir (TLC's states/
+analog), and stays disabled when neither is set.  Multi-host runs write
+one file per controller (``events_path`` suffixes the piece id), same
+model as checkpoint/trace pieces.
+
+A ``RunEventLog(None)`` is a no-op sink, so engines emit unconditionally.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Optional
+
+#: Event types a complete, healthy run always contains.
+REQUIRED_EVENTS = ("run_start", "run_end")
+
+
+def device_memory_stats() -> dict:
+    """Compact view of the first device's ``memory_stats()`` probe (the
+    same probe ``engine/bfs._auto_capacities`` sizes from); {} when the
+    backend reports nothing (virtual CPU devices) or jax is unavailable."""
+    try:
+        import jax
+        stats = jax.devices()[0].memory_stats() or {}
+    except Exception:
+        return {}
+    keep = ("bytes_in_use", "peak_bytes_in_use", "bytes_limit",
+            "largest_alloc_size")
+    return {k: int(stats[k]) for k in keep if k in stats}
+
+
+def events_path(events_out: Optional[str], checkpoint_dir: Optional[str],
+                process_index: int = 0,
+                process_count: int = 1) -> Optional[str]:
+    """Resolve the event-log path for one controller.  ``events_out``
+    wins; otherwise the file lands next to the checkpoints; None/None
+    disables.  Under a process group each controller writes its own
+    piece file (suffix before the extension), mirroring checkpoint
+    pieces — merge for dashboards by concatenation, order by ``ts``."""
+    path = events_out
+    if path is None and checkpoint_dir is not None:
+        path = os.path.join(checkpoint_dir, "events.jsonl")
+    if path is None or process_count <= 1:
+        return path
+    root, ext = os.path.splitext(path)
+    return f"{root}.p{process_index}of{process_count}{ext or '.jsonl'}"
+
+
+class RunEventLog:
+    """Append-only JSONL event writer; ``RunEventLog(None)`` discards."""
+
+    def __init__(self, path: Optional[str]):
+        self.path = path
+        self._f = None
+        self._t0 = time.time()
+        if path is not None:
+            d = os.path.dirname(os.path.abspath(path))
+            os.makedirs(d, exist_ok=True)
+            self._f = open(path, "a", encoding="utf-8")
+
+    @property
+    def enabled(self) -> bool:
+        return self._f is not None
+
+    def elapsed(self) -> float:
+        """Seconds since the log was opened (the run's true wall clock —
+        unlike the engines' budget clock ``t0`` it never shifts for
+        off-clock stalls, so phase sums can be audited against it)."""
+        return time.time() - self._t0
+
+    def emit(self, event: str, **fields) -> None:
+        if self._f is None:
+            return
+        now = time.time()
+        rec = {"event": event, "ts": round(now, 6),
+               "elapsed_seconds": round(now - self._t0, 6)}
+        rec.update(fields)
+        # One line per event, flushed immediately: a crashed run's log
+        # stays readable up to the crash (append-only, no buffering).
+        self._f.write(json.dumps(rec, default=str) + "\n")
+        self._f.flush()
+
+    def close(self) -> None:
+        f, self._f = self._f, None
+        if f is not None:
+            f.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+def validate_and_cleanup(events_file: str, scratch_dir: Optional[str],
+                         required=REQUIRED_EVENTS) -> int:
+    """Bench-harness gate: validate a run's event log, removing
+    ``scratch_dir`` whether validation succeeds or raises (a failing CI
+    run must not orphan its scratch directory either).  Returns the
+    event count; raises like :func:`validate_run_events`.  One shared
+    copy for ``bench.py`` and ``scripts/true_bench.py``."""
+    import shutil
+    try:
+        return len(validate_run_events(events_file, required=required))
+    finally:
+        if scratch_dir is not None:
+            shutil.rmtree(scratch_dir, ignore_errors=True)
+
+
+def validate_run_events(path: str,
+                        required=REQUIRED_EVENTS) -> list:
+    """Parse a run event log and verify it is healthy: the file exists,
+    every line is a JSON object with ``event`` and ``ts``, and every
+    ``required`` event type appears.  Returns the parsed events; raises
+    ``FileNotFoundError``/``ValueError`` otherwise.  This is the bench
+    harness's telemetry-regression gate (nonzero rc on failure)."""
+    if not os.path.exists(path):
+        raise FileNotFoundError(f"run event log missing: {path}")
+    events = []
+    with open(path, encoding="utf-8") as f:
+        for ln, line in enumerate(f, 1):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError as e:
+                raise ValueError(
+                    f"{path}:{ln}: malformed event line ({e})")
+            if not isinstance(rec, dict) or "event" not in rec \
+                    or "ts" not in rec:
+                raise ValueError(
+                    f"{path}:{ln}: event record missing 'event'/'ts': "
+                    f"{line[:120]}")
+            events.append(rec)
+    have = {e["event"] for e in events}
+    missing = [r for r in required if r not in have]
+    if missing:
+        raise ValueError(
+            f"{path}: incomplete run event log — missing {missing} "
+            f"(saw {sorted(have)})")
+    return events

@@ -44,7 +44,6 @@ from __future__ import annotations
 
 import os
 import time
-from functools import partial
 from typing import Callable, Dict, List, Optional
 
 import jax
@@ -64,6 +63,7 @@ from ..models.pystate import PyState
 from ..models.schema import (ROW_DTYPE, build_pack_guard, check_packable,
                              decode_state, encode_state, flatten_state,
                              state_width, unflatten_state)
+from ..obs import MetricsRegistry, RunEventLog, events_path
 from ..ops import compact as compact_mod
 from ..ops import fpset
 from ..ops.fingerprint import SENTINEL, build_fingerprint
@@ -83,6 +83,16 @@ class MeshBFSEngine:
         self.dims = dims
         self.config = config or EngineConfig()
         cfg = self.config
+        # Telemetry spine (obs/), shared with the single-chip engine.
+        # ``_rebuild_programs`` re-enters __init__ MID-RUN (seen-set
+        # growth), so an existing registry and open event log must
+        # survive the re-init — losing them would silently drop every
+        # phase total and event recorded before the first growth.
+        self.metrics = (cfg.metrics or getattr(self, "metrics", None)
+                        or MetricsRegistry())
+        if not hasattr(self, "_evlog"):
+            self._evlog = RunEventLog(None)
+            self._phase_base = {}
         if cfg.checkpoint_dir:
             # Fail at construction, not at the first level-boundary write.
             from ..engine import checkpoint as _ckpt
@@ -344,7 +354,8 @@ class MeshBFSEngine:
                     tuple(t[None] for t in tbuf_l), tcnt_l[None],
                     stats, vrow_g, vfp)
 
-        shard = partial(jax.shard_map, mesh=self.mesh, check_vma=False)
+        from ..utils.platform import compat_shard_map
+        shard = compat_shard_map(self.mesh)
         sx = P("x")
         rep = P()
         self._chunk = jax.jit(shard(
@@ -445,6 +456,26 @@ class MeshBFSEngine:
     # ------------------------------------------------------------------
     def run(self, init_states: Optional[List[PyState]] = None,
             resume=None) -> EngineResult:
+        """Telemetry wrapper (engine/bfs.py rationale): run_start/run_end
+        events bracket the run, phases are scoped to it.  Shared via duck
+        typing, like replay()."""
+        from ..engine.bfs import BFSEngine
+        return BFSEngine._telemetry_run(self, self._run_impl, init_states,
+                                        resume=resume)
+
+    def _events_path(self):
+        """One event-log piece per controller (multi-host checkpoint
+        model); single-controller resolution is unchanged."""
+        return events_path(self.config.events_out,
+                           self.config.checkpoint_dir,
+                           jax.process_index(), jax.process_count())
+
+    def _emit_level_event(self, res, frontier_rows):
+        from ..engine.bfs import BFSEngine
+        BFSEngine._emit_level_event(self, res, frontier_rows)
+
+    def _run_impl(self, init_states: Optional[List[PyState]] = None,
+                  resume=None) -> EngineResult:
         from ..engine import checkpoint as ckpt_mod
         from . import multihost as mh
         dims, cfg = self.dims, self.config
@@ -517,6 +548,8 @@ class MeshBFSEngine:
             self._trace_run_id = mh.build_min(self.mesh)(
                 int(time.time() * 1000) & 0x7FFFFFFF)
         res = EngineResult(pipeline="v2" if self._v2 is not None else "v1")
+        self._cur_res = res     # run_end event reads it on error exits
+        mt, evlog = self.metrics, self._evlog
         self._growth_stalls = res.growth_stalls
         t_enter = time.time()
         trace = make_trace_store() if cfg.record_trace else TraceStore()
@@ -564,58 +597,80 @@ class MeshBFSEngine:
 
         def resolve_spill():
             while inflight:
-                arr, cnts = inflight.pop(0)
-                # _drain copies per-chip slices (np.concatenate), so no
-                # view into the recycled buffer survives.  A controller
-                # whose shards were all empty contributes no segment.
-                rows = self._drain(arr, cnts)
-                if len(rows):
-                    spill_next.append(rows)
-                free_q.append(arr)
+                with mt.phase_timer("spill"):
+                    arr, cnts = inflight.pop(0)
+                    # _drain copies per-chip slices (np.concatenate), so
+                    # no view into the recycled buffer survives.  A
+                    # controller whose shards were all empty contributes
+                    # no segment.
+                    rows = self._drain(arr, cnts)
+                    if len(rows):
+                        spill_next.append(rows)
+                    free_q.append(arr)
 
         if resume is None:
             encoded = [encode_state(s, dims) for s in init_states]
             if self._root_check is not None:
-                v = find_root_violation(self._root_check, encoded,
-                                        init_states, B, self.inv_names)
+                with mt.phase_timer("root_check"):
+                    v = find_root_violation(self._root_check, encoded,
+                                            init_states, B, self.inv_names)
                 if v is not None:   # before warm-up: no checking time spent
+                    if cfg.record_trace:
+                        # Depth-0 counterexample must stay replayable:
+                        # register the violating root under the Violation's
+                        # fingerprint (engine/bfs.py rationale), and under
+                        # a process group ALSO write this controller's
+                        # trace piece — every controller takes this same
+                        # early return (roots are replicated), and a
+                        # sibling's replay() would otherwise block in
+                        # _merge_trace_pieces waiting for a piece that was
+                        # never written.
+                        trace.roots.setdefault(v.fingerprint, v.state)
+                        if mp:
+                            self._write_trace_piece(trace)
+                            self._trace_merged = False
                     res.violation = v
                     res.stop_reason = "violation"
                     res.levels.append(0)
                     res.wall_seconds = time.time() - t_enter
+                    evlog.emit("violation", invariant=v.invariant,
+                               fingerprint=hex(v.fingerprint), level=0)
                     return res
             for e in encoded:       # reject silently-aliasing roots
                 check_packable(e, self.dims)
             rows_np = np.stack([flatten_state(e, dims) for e in encoded])
             if cfg.record_trace:
-                rhi, rlo = (np.asarray(x) for x in
-                            self._fp_rows(jnp.asarray(rows_np)))
-                for idx, s in enumerate(init_states):
-                    trace.roots.setdefault(
-                        (int(rhi[idx]) << 32) | int(rlo[idx]), s)
+                with mt.phase_timer("root_check"):
+                    rhi, rlo = (np.asarray(x) for x in
+                                self._fp_rows(jnp.asarray(rows_np)))
+                    for idx, s in enumerate(init_states):
+                        trace.roots.setdefault(
+                            (int(rhi[idx]) << 32) | int(rlo[idx]), s)
 
         # Warm-up compilation before the duration clock starts.  Inputs go
         # through put_global so each controller materializes only its own
         # shards (multihost.py rule 3; identical single-host).
         zero_counts = mh.put_global(np.zeros((n,), np.int32),
                                     self.mesh, P("x"))
-        out = self._ingest(
-            mh.put_global(np.zeros((n, B, sw), ROW_DTYPE),
-                          self.mesh, P("x")),
-            mh.put_global(np.zeros((n, B), bool), self.mesh, P("x")),
-            qnext, next_counts, shi, slo, ssize, tbuf, tcount)
-        qnext, next_counts, shi, slo, ssize, tbuf = out[:6]
-        out = self._chunk(qcur, zero_counts, jnp.int32(0),
-                          qnext, next_counts, shi, slo, ssize, tbuf,
-                          tcount, jnp.int32(self._CH))
-        qnext, next_counts, shi, slo, ssize, tbuf = out[:6]
-        # Placement-fixpoint second call (engine/bfs.py warm-up rationale):
-        # free when outputs already carry the input shardings, and
-        # pre-compiles the output-placement variant when they don't.
-        out = self._chunk(qcur, zero_counts, jnp.int32(0),
-                          qnext, next_counts, shi, slo, ssize, tbuf,
-                          tcount, jnp.int32(self._CH))
-        qnext, next_counts, shi, slo, ssize, tbuf = out[:6]
+        with mt.phase_timer("warmup"):
+            out = self._ingest(
+                mh.put_global(np.zeros((n, B, sw), ROW_DTYPE),
+                              self.mesh, P("x")),
+                mh.put_global(np.zeros((n, B), bool), self.mesh, P("x")),
+                qnext, next_counts, shi, slo, ssize, tbuf, tcount)
+            qnext, next_counts, shi, slo, ssize, tbuf = out[:6]
+            out = self._chunk(qcur, zero_counts, jnp.int32(0),
+                              qnext, next_counts, shi, slo, ssize, tbuf,
+                              tcount, jnp.int32(self._CH))
+            qnext, next_counts, shi, slo, ssize, tbuf = out[:6]
+            # Placement-fixpoint second call (engine/bfs.py warm-up
+            # rationale): free when outputs already carry the input
+            # shardings, and pre-compiles the output-placement variant
+            # when they don't.
+            out = self._chunk(qcur, zero_counts, jnp.int32(0),
+                              qnext, next_counts, shi, slo, ssize, tbuf,
+                              tcount, jnp.int32(self._CH))
+            qnext, next_counts, shi, slo, ssize, tbuf = out[:6]
         t0 = time.time()
         last_progress = t0
         self._batch_ema = 0.0
@@ -705,29 +760,36 @@ class MeshBFSEngine:
                     part = per_chip[d][c * B:(c + 1) * B]
                     wave[d, :len(part)] = part
                     valid[d, :len(part)] = True
-                out = self._ingest(mh.put_global(wave, self.mesh, P("x")),
-                                   mh.put_global(valid, self.mesh, P("x")),
-                                   qnext, next_counts, shi, slo, ssize,
-                                   tbuf, tcount)
-                (qnext, next_counts, shi, slo, ssize, tbuf, tcount,
-                 istats, ivrow, ivfp) = out
-                ist = np.asarray(istats)
+                with mt.phase_timer("ingest"):
+                    out = self._ingest(
+                        mh.put_global(wave, self.mesh, P("x")),
+                        mh.put_global(valid, self.mesh, P("x")),
+                        qnext, next_counts, shi, slo, ssize,
+                        tbuf, tcount)
+                    (qnext, next_counts, shi, slo, ssize, tbuf, tcount,
+                     istats, ivrow, ivfp) = out
+                    ist = np.asarray(istats)
                 res.distinct += int(ist[0])
+                mt.counter("engine/distinct", int(ist[0]))
                 cur_sum = int(ist[3])
                 if int(ist[1]):
                     raise RuntimeError("seen-set probe failure during "
                                        "ingest; raise seen_capacity")
-                self._flush_trace(trace, tbuf, tcount)
+                with mt.phase_timer("trace_flush"):
+                    self._flush_trace(trace, tbuf, tcount)
                 tcount = sharded_full((n,), _I32)
                 (shi, slo, ssize, qnext, next_counts, tbuf,
                  t0) = self._grow_precompiled(shi, slo, ssize, qcur, qnext,
                                               next_counts, tbuf, tcount,
                                               t0, int(ist[6]))
                 if int(ist[2]) > self._QTH:  # ingest adds <= B per wave
-                    rows = self._drain(
-                        qnext, self._local_counts(next_counts))
-                    if len(rows):
-                        spill_next.append(rows)
+                    with mt.phase_timer("spill"):
+                        rows = self._drain(
+                            qnext, self._local_counts(next_counts))
+                        if len(rows):
+                            spill_next.append(rows)
+                    evlog.emit("spill", rows=cur_sum, level=0,
+                               where="ingest")
                     drained += cur_sum
                     cur_sum = 0
                     next_counts = sharded_full((n,), _I32)
@@ -735,6 +797,7 @@ class MeshBFSEngine:
                     break
             level_rows = drained + cur_sum
             res.levels.append(level_rows)
+            self._emit_level_event(res, level_rows)
             qcur, qnext = qnext, qcur
             cur_counts_dev = next_counts
             next_counts = sharded_full((n,), _I32)
@@ -755,10 +818,14 @@ class MeshBFSEngine:
                     # — agree, so groups are always complete.
                     want_ckpt = any_flag(want_ckpt)
                 if want_ckpt:
-                    self._write_checkpoint(qcur, cur_counts_dev, pending,
-                                           shi, slo, res, trace,
-                                           wall=time.time() - t0)
+                    with mt.phase_timer("checkpoint"):
+                        self._write_checkpoint(qcur, cur_counts_dev,
+                                               pending, shi, slo, res,
+                                               trace,
+                                               wall=time.time() - t0)
                     last_ckpt = time.time()
+                    evlog.emit("checkpoint", level=res.diameter,
+                               distinct=res.distinct)
             if cfg.max_diameter is not None \
                     and res.diameter >= cfg.max_diameter:
                 res.stop_reason = "diameter_budget"
@@ -801,13 +868,18 @@ class MeshBFSEngine:
                             break
                     calls_in_level += 1
                     t_call = time.time()
-                    out = self._chunk(
-                        qcur, cur_counts_dev,
-                        jnp.int32(offset), qnext, next_counts, shi, slo,
-                        ssize, tbuf, tcount, jnp.int32(allowed))
-                    (qnext, next_counts, shi, slo, ssize, tbuf, tcount,
-                     stats, drow_g, vrow_g, vfp_g) = out
-                    st = np.asarray(stats)
+                    with mt.phase_timer("chunk"):
+                        out = self._chunk(
+                            qcur, cur_counts_dev,
+                            jnp.int32(offset), qnext, next_counts, shi,
+                            slo, ssize, tbuf, tcount, jnp.int32(allowed))
+                        (qnext, next_counts, shi, slo, ssize, tbuf,
+                         tcount, stats, drow_g, vrow_g, vfp_g) = out
+                    # One blocking sync per chunk call (engine/bfs.py):
+                    # this phase is the mesh's device compute + collective
+                    # time.
+                    with mt.phase_timer("stats_fetch"):
+                        st = np.asarray(stats)
                     if int(st[1]):
                         per = (time.time() - t_call) / int(st[1])
                         # Conservative: jump up instantly, decay slowly
@@ -820,6 +892,13 @@ class MeshBFSEngine:
                     cur_sum = int(st[8])
                     res.generated += int(st[2])
                     res.distinct += int(st[3])
+                    # Packed-stats fetch feeds the registry (the one live
+                    # counter source — engine/bfs.py rationale).
+                    mt.counter("engine/generated", int(st[2]))
+                    mt.counter("engine/distinct", int(st[3]))
+                    mt.gauge("engine/seen_size", int(st[10]))
+                    mt.gauge("engine/next_count", cur_sum)
+                    mt.gauge("engine/diameter", res.diameter)
                     if int(st[2]):
                         for name, c in zip(dims.family_names, st[15:]):
                             res.action_counts[name] = (
@@ -835,7 +914,8 @@ class MeshBFSEngine:
                             "seen-set probe failure (load spiked within "
                             "one chunk); raise seen_capacity or lower "
                             "sync_every")
-                    self._flush_trace(trace, tbuf, tcount)
+                    with mt.phase_timer("trace_flush"):
+                        self._flush_trace(trace, tbuf, tcount)
                     tcount = sharded_full((n,), _I32)
                     (shi, slo, ssize, qnext, next_counts, tbuf,
                      t0) = self._grow_precompiled(
@@ -853,11 +933,15 @@ class MeshBFSEngine:
                                          else bool(pending))
                         if more_here:
                             resolve_spill()
-                            cnts = self._local_counts(next_counts)
-                            qnext.copy_to_host_async()
-                            inflight.append((qnext, cnts))
-                            qnext = free_q.pop()
-                            next_counts = sharded_full((n,), _I32)
+                            with mt.phase_timer("spill"):
+                                cnts = self._local_counts(next_counts)
+                                qnext.copy_to_host_async()
+                                inflight.append((qnext, cnts))
+                                qnext = free_q.pop()
+                                next_counts = sharded_full((n,), _I32)
+                            evlog.emit("spill", rows=cur_sum,
+                                       level=res.diameter,
+                                       where="chunk_loop")
                             drained += cur_sum
                             cur_sum = 0
                     if int(st[11]):
@@ -868,11 +952,17 @@ class MeshBFSEngine:
                                 np.asarray(vrow_g), dims), dims),
                             fingerprint=(int(vf[0]) << 32) | int(vf[1]))
                         res.stop_reason = "violation"
+                        evlog.emit(
+                            "violation",
+                            invariant=res.violation.invariant,
+                            fingerprint=hex(res.violation.fingerprint),
+                            level=res.diameter)
                         break
                     if int(st[12]) and self._check_deadlock:
                         res.deadlock = decode_state(unflatten_state(
                             np.asarray(drow_g), dims), dims)
                         res.stop_reason = "deadlock"
+                        evlog.emit("deadlock", level=res.diameter)
                         break
                     want_progress = bool(
                         cfg.progress_interval_seconds
@@ -892,7 +982,8 @@ class MeshBFSEngine:
                         queue_rows = (
                             int(st[9]) + cur_sum + local_pools)
                         if want_progress:
-                            _progress_line(res, t0, queue_rows, int(st[14]))
+                            _progress_line(res, t0, queue_rows,
+                                           int(st[14]), metrics=mt)
                             last_progress = time.time()
                         # Last: a violation/deadlock in the same chunk
                         # outranks a budget stop (engine/bfs.py rationale).
@@ -912,36 +1003,39 @@ class MeshBFSEngine:
                 # controller's chips (each controller re-uploads its own
                 # pool; the segment cap keeps any one upload within QL
                 # rows per chip).
-                my_rows = [i for i, d in
-                           enumerate(self.mesh.devices.flat)
-                           if d.process_index == jax.process_index()]
-                cap = len(my_rows) * QL
-                seg = pending.pop(0) if pending else \
-                    np.zeros((0, sw), ROW_DTYPE)
-                while len(seg) > cap:
-                    pending.insert(0, seg[cap:])
-                    seg = seg[:cap]
-                bufs = {}
-                cnts = np.zeros((n,), np.int32)
-                share = -(-len(seg) // len(my_rows)) if len(seg) else 0
-                for k, di in enumerate(my_rows):
-                    part = seg[k * share:(k + 1) * share] if share else \
-                        seg[:0]
-                    b = np.zeros((QLA, sw), ROW_DTYPE)
-                    b[:len(part)] = part
-                    bufs[di] = b[None]
-                    cnts[di] = len(part)
-                shq = NamedSharding(self.mesh, P("x"))
-                qcur = jax.make_array_from_callback(
-                    (n, QLA, sw), shq, lambda idx: bufs[idx[0].start])
-                cur_counts_dev = jax.make_array_from_callback(
-                    (n,), shq, lambda idx: cnts[idx[0].start:idx[0].stop])
+                with mt.phase_timer("upload"):
+                    my_rows = [i for i, d in
+                               enumerate(self.mesh.devices.flat)
+                               if d.process_index == jax.process_index()]
+                    cap = len(my_rows) * QL
+                    seg = pending.pop(0) if pending else \
+                        np.zeros((0, sw), ROW_DTYPE)
+                    while len(seg) > cap:
+                        pending.insert(0, seg[cap:])
+                        seg = seg[:cap]
+                    bufs = {}
+                    cnts = np.zeros((n,), np.int32)
+                    share = -(-len(seg) // len(my_rows)) if len(seg) else 0
+                    for k, di in enumerate(my_rows):
+                        part = seg[k * share:(k + 1) * share] if share \
+                            else seg[:0]
+                        b = np.zeros((QLA, sw), ROW_DTYPE)
+                        b[:len(part)] = part
+                        bufs[di] = b[None]
+                        cnts[di] = len(part)
+                    shq = NamedSharding(self.mesh, P("x"))
+                    qcur = jax.make_array_from_callback(
+                        (n, QLA, sw), shq, lambda idx: bufs[idx[0].start])
+                    cur_counts_dev = jax.make_array_from_callback(
+                        (n,), shq,
+                        lambda idx: cnts[idx[0].start:idx[0].stop])
             if res.stop_reason != "exhausted" or res.violation is not None:
                 break
             resolve_spill()      # level boundary: all drains must land
             res.diameter += 1
             level_rows = drained + cur_sum
             res.levels.append(level_rows)
+            self._emit_level_event(res, level_rows)
             qcur, qnext = qnext, qcur
             cur_counts_dev = next_counts
             next_counts = sharded_full((n,), _I32)
@@ -1006,8 +1100,17 @@ class MeshBFSEngine:
             # Off the clock, but recorded (engine/bfs.py rationale): mesh
             # growth additionally re-inits + retraces both programs, the
             # expensive path VERDICT r3 weak #7 wants measured on silicon.
+            # The stall IS the phase time (rehash + retrace + precompile),
+            # so it is observed directly rather than via phase_timer.
             self._growth_stalls.append(
                 (self.n_dev * self._CL, round(stall, 3)))
+            from ..obs import PHASE_PREFIX, device_memory_stats
+            self.metrics.observe(PHASE_PREFIX + "fpset_grow", stall)
+            self.metrics.counter("engine/fpset_resizes")
+            self._evlog.emit("fpset_resize",
+                             capacity=self.n_dev * self._CL,
+                             stall_seconds=round(stall, 3),
+                             memory=device_memory_stats())
         return shi, slo, ssize, qnext, next_counts, tbuf, t0
 
     def _write_checkpoint(self, qcur, cur_counts, pending, shi, slo, res,
@@ -1125,12 +1228,27 @@ class MeshBFSEngine:
             os.fsync(f.fileno())
         os.replace(tmp, path)
 
-    def _merge_trace_pieces(self, timeout_s: float = 30.0) -> None:
+    def _merge_trace_pieces(self, timeout_s: Optional[float] = None) -> None:
         """Fold every sibling controller's trace piece into this store
         (idempotent; records are keyed by fingerprint).  Sibling files
-        appear within the skew of the collective run exit; poll briefly
-        rather than requiring an extra barrier."""
+        appear within the skew of the collective run exit; poll rather
+        than requiring an extra barrier.
+
+        Deadline: ``EngineConfig.trace_merge_timeout_seconds`` when set;
+        otherwise a 30 s base plus an allowance proportional to THIS
+        controller's piece size — pieces are written at the same exit
+        with similar record counts, so a big local piece predicts
+        siblings still compressing/fsyncing theirs (~8 MB/s floor)."""
         m = jax.process_count()
+        my_piece = self._trace_piece_path(jax.process_index(), m)
+        try:
+            my_bytes = os.path.getsize(my_piece)
+        except OSError:
+            my_bytes = 0
+        if timeout_s is None:
+            timeout_s = self.config.trace_merge_timeout_seconds
+        if timeout_s is None:
+            timeout_s = 30.0 + my_bytes / (8 << 20)
         deadline = time.time() + timeout_s
         for i in range(m):
             if i == jax.process_index():
@@ -1140,10 +1258,17 @@ class MeshBFSEngine:
                 if time.time() > deadline:
                     raise FileNotFoundError(
                         f"trace piece {path} not written within "
-                        f"{timeout_s}s — did controller {i} exit the run?")
+                        f"{timeout_s:.0f}s — controller {i} may still be "
+                        f"compressing its piece (this controller's was "
+                        f"{my_bytes} bytes; larger traces take longer), "
+                        f"or it exited the run abnormally.  If it is just "
+                        f"slow, raise "
+                        f"EngineConfig.trace_merge_timeout_seconds")
                 time.sleep(0.05)
-            with np.load(path) as z:
-                self.trace.add_batch(z["fps"], z["parents"], z["actions"])
+            with self.metrics.phase_timer("trace_merge"):
+                with np.load(path) as z:
+                    self.trace.add_batch(z["fps"], z["parents"],
+                                         z["actions"])
 
     def _check_violation_ingest(self, res, ist, vrow, vfp) -> bool:
         """``ist``/``vrow``/``vfp`` are the ingest program's replicated

@@ -43,7 +43,6 @@ in parallel/simulate.py):
 from __future__ import annotations
 
 import os
-from functools import partial
 
 import jax
 import jax.numpy as jnp
@@ -96,7 +95,8 @@ def _build_agree(mesh: Mesh, reduce_fn):
     def agree(vals):
         return reduce_fn(vals[0], "x")
 
-    return jax.jit(partial(jax.shard_map, mesh=mesh, check_vma=False)(
+    from ..utils.platform import compat_shard_map
+    return jax.jit(compat_shard_map(mesh)(
         agree, in_specs=P("x"), out_specs=P()))
 
 
@@ -164,7 +164,8 @@ def build_budget_agree(mesh: Mesh):
         return jnp.stack([jax.lax.psum(v[0], "x"),
                           jax.lax.pmin(v[1], "x")])
 
-    fn = jax.jit(partial(jax.shard_map, mesh=mesh, check_vma=False)(
+    from ..utils.platform import compat_shard_map
+    fn = jax.jit(compat_shard_map(mesh)(
         agree, in_specs=P("x"), out_specs=P()))
 
     def budget(over: bool, allowed: int):

@@ -15,7 +15,6 @@ this is the TLC ``-workers N`` analog for simulation mode.
 from __future__ import annotations
 
 import time
-from functools import partial
 from typing import Callable, Dict, List, Optional
 
 import jax
@@ -35,7 +34,7 @@ class MeshSimulator:
                  invariants: Optional[Dict[str, Callable]] = None,
                  constraint: Optional[Callable] = None,
                  batch: int = 256, depth: int = 100, chunk: int = 128,
-                 devices=None, pipeline: str = "auto"):
+                 devices=None, pipeline: str = "auto", metrics=None):
         self.dims = dims
         self.inv_names = list((invariants or {}).keys())
         inv_fns = list((invariants or {}).values())
@@ -64,7 +63,8 @@ class MeshSimulator:
                     abuf_o[None], jax.lax.psum(restarts, "x"),
                     g_vf, g_vinv, g_vroot, g_vlen, g_vacts, g_vchoice)
 
-        shard = partial(jax.shard_map, mesh=self.mesh, check_vma=False)
+        from ..utils.platform import compat_shard_map
+        shard = compat_shard_map(self.mesh)
         sx, rep = P("x"), P()
         self._chunk = jax.jit(shard(
             sharded,
@@ -77,7 +77,8 @@ class MeshSimulator:
         # _roots_inv, _reconstruct, and _prepare_roots are used).
         self._single = Simulator(dims, invariants=invariants,
                                  constraint=constraint, batch=batch,
-                                 depth=depth, chunk=chunk)
+                                 depth=depth, chunk=chunk, metrics=metrics)
+        self.metrics = self._single.metrics   # one registry, both paths
 
     # ------------------------------------------------------------------
     def run(self, roots: List[PyState], num_steps: int, seed: int = 0,
@@ -114,15 +115,21 @@ class MeshSimulator:
                     if mh.is_multiprocess() and max_seconds is not None
                     else None)
 
+        mt = self.metrics
         while res.steps < num_steps:
             key, sub = jax.random.split(key)
             keys = mh.put_global(np.asarray(jax.random.split(sub, n)),
                                  mesh, P("x"))
-            out = self._chunk(rows, roots_j, tstep, cur_root, abuf, keys)
+            with mt.phase_timer("sim_chunk"):
+                out = self._chunk(rows, roots_j, tstep, cur_root, abuf,
+                                  keys)
             (rows, tstep, cur_root, abuf, g_restarts, g_vf, g_vinv,
              g_vroot, g_vlen, g_vacts, g_vchoice) = out
             res.steps += n * B * self.chunk
-            res.traces += int(np.asarray(g_restarts))
+            with mt.phase_timer("sim_fetch"):
+                res.traces += int(np.asarray(g_restarts))
+            mt.counter("sim/steps", n * B * self.chunk)
+            mt.gauge("sim/traces", res.traces)
             if bool(np.asarray(g_vf)):
                 self._single._reconstruct(
                     res, roots, int(np.asarray(g_vinv)),

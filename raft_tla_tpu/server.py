@@ -28,9 +28,17 @@ response per line.  Requests:
      "batch": B, "seed": 0, "max_seconds": S}
         -> {"ok": true, "steps": N, "traces": N, "wall_seconds": S,
             "violation": null | {...}}
+    {"op": "stats"}
+        -> {"ok": true, "metrics": {counters, gauges, histograms},
+            "engine_cache": {"size": n, "capacity": c},
+            "sim_cache": {...}}
+       Live telemetry (obs/): per-op request counts and latency
+       histograms, engine/sim LRU cache hit/miss/eviction counters.
+       Served WITHOUT the device lock, so it answers while a check runs.
 
-Errors: {"ok": false, "error": "<message>"}.  Requests are served one at
-a time (a checking run owns the device); concurrent connections queue.
+Errors: {"ok": false, "error": "<message>"}.  check/simulate are served
+one at a time (a checking run owns the device); concurrent connections
+queue.  ping/stats never queue behind them.
 
 Run:  python -m raft_tla_tpu.server [--port 8610] [--platform cpu]
 
@@ -53,6 +61,11 @@ import threading
 from typing import Optional
 
 _LOCK = threading.Lock()          # one engine run at a time (one device)
+# Process-global telemetry (obs/): request/latency/cache counters for
+# every handler thread, exposed verbatim by the "stats" op.  The obs
+# package never imports jax, so this is safe before platform selection.
+from .obs import MetricsRegistry  # noqa: E402
+_METRICS = MetricsRegistry()
 # Warm caches, LRU-capped: a long-lived service iterating on cfg_text
 # variants must not pin one compiled engine (plus its trace store) per
 # variant forever.
@@ -62,17 +75,23 @@ _ENGINES: "OrderedDict" = OrderedDict()   # (cfg identity, opts) -> engine
 _SIMS: "OrderedDict" = OrderedDict()      # ditto for simulators
 
 
-def _cache_put(cache: "OrderedDict", key, value):
+def _cache_put(cache: "OrderedDict", key, value, name: str):
     cache[key] = value
     cache.move_to_end(key)
     while len(cache) > _CACHE_CAP:
         cache.popitem(last=False)
+        _METRICS.counter(f"server/{name}/evictions")
 
 
-def _cache_get(cache: "OrderedDict", key):
+def _cache_get(cache: "OrderedDict", key, name: str):
     v = cache.get(key)
     if v is not None:
         cache.move_to_end(key)
+    # Hit/miss counters per LRU cache: a miss on a repeat model means the
+    # cap is churning compiled engines — the number that tells an operator
+    # to raise _CACHE_CAP before blaming XLA.
+    _METRICS.counter(f"server/{name}/" + ("hits" if v is not None
+                                          else "misses"))
     return v
 
 
@@ -154,7 +173,7 @@ def _do_check(req):
     key = (ident, req.get("engine", "single"), cfg.batch,
            cfg.queue_capacity, cfg.seen_capacity, record_trace,
            cfg.check_deadlock)
-    engine = _cache_get(_ENGINES, key)
+    engine = _cache_get(_ENGINES, key, "engine_cache")
     if engine is None:
         engine_cls = None
         if req.get("engine") == "mesh":
@@ -165,7 +184,7 @@ def _do_check(req):
         # make_engine applies the cfg-file fallbacks (CHECK_DEADLOCK,
         # StopAfter) identically for both engine classes.
         engine = make_engine(setup, cfg, engine_cls=engine_cls)
-        _cache_put(_ENGINES, key, engine)
+        _cache_put(_ENGINES, key, engine, "engine_cache")
     # Budgets are per-request: apply the request value (or the cfg-file
     # fallback) to the warm engine's host-side config.
     engine.config.max_seconds = (cfg.max_seconds
@@ -184,6 +203,9 @@ def _do_check(req):
            # (capacity-after, off-clock stall seconds) per seen-set
            # doubling — the SEEN_CAPACITY sizing evidence.
            "growth_stalls": list(res.growth_stalls),
+           # Host-side per-phase wall-time breakdown for THIS run
+           # (obs/ phase timers) — same shape bench.py embeds.
+           "phases": {k: round(v, 4) for k, v in res.phases.items()},
            "violation": None, "deadlock": None}
     if res.violation is not None:
         out["violation"] = _violation_json(engine, res.violation,
@@ -203,13 +225,13 @@ def _do_simulate(req):
              else int(setup.backend.get("BATCH", 1024)))
     depth = int(req.get("depth", 100))
     key = (ident, batch, depth)
-    sim = _cache_get(_SIMS, key)   # warm path, like _ENGINES for checks
+    sim = _cache_get(_SIMS, key, "sim_cache")  # warm path, like _ENGINES
     if sim is None:
         sim = Simulator(setup.dims,
                         invariants=resolve_invariants(setup),
                         constraint=resolve_constraint(setup),
                         batch=batch, depth=depth)
-        _cache_put(_SIMS, key, sim)
+        _cache_put(_SIMS, key, sim, "sim_cache")
     res = sim.run(initial_states(setup, seed=int(req.get("seed", 0))),
                   num_steps=int(req.get("num_steps", 1 << 20)),
                   seed=int(req.get("seed", 0)),
@@ -228,20 +250,49 @@ def _do_simulate(req):
     return out
 
 
+def _do_stats() -> dict:
+    """The live-stats endpoint: the process-global registry verbatim
+    (request counts, per-op latency histograms, LRU cache hit/miss/
+    eviction counters) plus the caches' occupancy.  Read-only and
+    lock-free — it answers instantly even while a check owns the device
+    lock, which is the whole point of a LIVE stats op."""
+    return {"ok": True,
+            "metrics": _METRICS.snapshot(),
+            "engine_cache": {"size": len(_ENGINES),
+                             "capacity": _CACHE_CAP},
+            "sim_cache": {"size": len(_SIMS), "capacity": _CACHE_CAP}}
+
+
 def handle_request(req: dict) -> dict:
     op = req.get("op")
-    try:
-        if op == "ping":
-            import jax
-            return {"ok": True, "platform": jax.devices()[0].platform}
-        with _LOCK:
-            if op == "check":
-                return _do_check(req)
-            if op == "simulate":
-                return _do_simulate(req)
-        return {"ok": False, "error": f"unknown op {op!r}"}
-    except Exception as e:
-        return {"ok": False, "error": f"{type(e).__name__}: {e}"}
+    # Metric names must not echo client-controlled strings: one counter +
+    # histogram per distinct bogus op would grow the process-global
+    # registry without bound in this long-lived service.
+    op_label = op if op in ("ping", "check", "simulate", "stats") \
+        else "unknown"
+    _METRICS.counter(f"server/requests/{op_label}")
+    ok = False
+    with _METRICS.phase_timer(f"request/{op_label}"):
+        try:
+            if op == "ping":
+                import jax
+                resp = {"ok": True,
+                        "platform": jax.devices()[0].platform}
+            elif op == "stats":
+                resp = _do_stats()
+            elif op in ("check", "simulate"):
+                with _LOCK:
+                    resp = (_do_check(req) if op == "check"
+                            else _do_simulate(req))
+            else:
+                resp = {"ok": False, "error": f"unknown op {op!r}"}
+            ok = bool(resp.get("ok"))
+            return resp
+        except Exception as e:
+            return {"ok": False, "error": f"{type(e).__name__}: {e}"}
+        finally:
+            if not ok:
+                _METRICS.counter(f"server/errors/{op_label}")
 
 
 class _Handler(socketserver.StreamRequestHandler):

@@ -30,7 +30,7 @@ comments, a backend-annotated cfg still parses and runs under stock TLC
 unchanged — the cfg stays the single source of truth for both engines.
 Recognized keys: BATCH, QUEUE_CAPACITY, SEEN_CAPACITY, N_MSG_SLOTS,
 MAX_LOG, PLATFORM, CHECKPOINT_DIR, CHECKPOINT_EVERY, CHECKPOINT_INTERVAL,
-SPILL_DIR.
+SPILL_DIR, TRACE_DIR, PROGRESS_SECONDS, EVENTS_OUT.
 Precedence everywhere: CLI flag > cfg backend key > built-in default.
 """
 
@@ -78,7 +78,7 @@ def _tokenize(text: str) -> List[str]:
 _BACKEND_KEYS = {
     "BATCH", "QUEUE_CAPACITY", "SEEN_CAPACITY", "N_MSG_SLOTS", "MAX_LOG",
     "PLATFORM", "CHECKPOINT_DIR", "CHECKPOINT_EVERY", "CHECKPOINT_INTERVAL",
-    "SPILL_DIR", "TRACE_DIR", "PROGRESS_SECONDS",
+    "SPILL_DIR", "TRACE_DIR", "PROGRESS_SECONDS", "EVENTS_OUT",
 }
 
 

@@ -90,3 +90,27 @@ def enable_persistent_cache() -> None:
         jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
     except Exception:
         pass
+
+
+def compat_shard_map(mesh):
+    """``shard_map(fn, mesh, in_specs, out_specs)`` across jax versions.
+
+    Newer jax exposes ``jax.shard_map`` (replication checking spelled
+    ``check_vma``); 0.4.x ships it as
+    ``jax.experimental.shard_map.shard_map`` with the ``check_rep``
+    spelling.  Returns ``shard(fn, in_specs=..., out_specs=...)`` bound to
+    ``mesh`` with replication checking off on either API — like the
+    backend-factory workaround above, version-compat jax surface lives in
+    this one module."""
+    import functools
+
+    import jax
+    if hasattr(jax, "shard_map"):
+        return functools.partial(jax.shard_map, mesh=mesh, check_vma=False)
+    from jax.experimental.shard_map import shard_map as _sm
+
+    def shard(fn, *, in_specs, out_specs):
+        return _sm(fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                   check_rep=False)
+
+    return shard

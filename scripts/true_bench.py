@@ -53,10 +53,26 @@ def main():
     # expand+fingerprint pass over it supplies real candidate keys.
     from raft_tla_tpu.engine.bfs import EngineConfig
     from raft_tla_tpu.engine.check import initial_states, make_engine
+    # The warm-up run doubles as the telemetry-regression gate (same
+    # contract as bench.py): its event log must exist and parse, or the
+    # whole measurement exits nonzero — microbenchmark numbers from an
+    # unobservable engine are not trustworthy evidence.
+    import tempfile
+    scratch_dir = tempfile.mkdtemp(prefix="tb_obs_")
     warm = make_engine(setup, EngineConfig(
         batch=B, queue_capacity=1 << 20, seen_capacity=1 << 23,
-        record_trace=False, check_deadlock=False, max_diameter=4))
+        record_trace=False, check_deadlock=False, max_diameter=4,
+        events_out=os.path.join(scratch_dir, "events.jsonl")))
     warm.run(initial_states(setup))
+    # Engine-resolved path + cleanup-on-both-outcomes, shared with
+    # bench.py (obs.validate_and_cleanup).
+    from raft_tla_tpu.obs import validate_and_cleanup
+    try:
+        validate_and_cleanup(warm._events_path(), scratch_dir)
+    except (OSError, ValueError) as e:
+        print(f"true_bench: telemetry regression — event log invalid: {e}",
+              file=sys.stderr)
+        sys.exit(1)
     wrows = warm._last_frontier
     rows = jnp.asarray(np.tile(wrows, (-(-B // len(wrows)), 1))[:B])
     expand = build_expand(dims)

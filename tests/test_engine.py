@@ -522,6 +522,24 @@ def test_path_to_state_recovers_minimal_counterexample():
         assert s_next[1] in orc.successor_set(s_prev[1], DIMS)
 
 
+def test_run_emits_level_complete_events(tmp_path):
+    """Telemetry contract (obs/): any events_out run logs run_start, one
+    level_complete per BFS level with live counters and a per-phase
+    wall-time breakdown, and run_end; the result object carries the same
+    phase totals.  (Schema details in tests/test_obs.py.)"""
+    from raft_tla_tpu.obs import validate_run_events
+    ev = str(tmp_path / "events.jsonl")
+    eng = BFSEngine(DIMS, constraint=build_constraint(DIMS, BOUNDS),
+                    config=small_config(max_diameter=3, events_out=ev))
+    res = eng.run([init_state(DIMS)])
+    events = validate_run_events(ev)
+    levels = [e for e in events if e["event"] == "level_complete"]
+    assert [e["frontier_rows"] for e in levels] == res.levels
+    assert levels[-1]["distinct"] == res.distinct
+    assert levels[-1]["phase_seconds"]
+    assert res.phases.get("chunk", 0) > 0
+
+
 def test_path_to_state_edge_cases():
     """Robustness of the extractor contract: a trace-less caller config
     must not break replay, a root target yields the trivial path, and

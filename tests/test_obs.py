@@ -1,0 +1,285 @@
+"""Telemetry subsystem tests (obs/): registry semantics, event-log
+schema, and the engine/CLI integrations.
+
+The registry/event-log halves are tested standalone (they are zero-dep
+and must stay importable without jax); the integration tests then assert
+the ISSUE acceptance contract end-to-end: a run's JSONL log contains
+run_start, level_complete events whose per-phase timings account for the
+wall clock, and run_end — through both the BFSEngine API and the CLI.
+"""
+
+import json
+import os
+import threading
+
+import jax.numpy as jnp
+import pytest
+
+from raft_tla_tpu.engine.bfs import BFSEngine, EngineConfig
+from raft_tla_tpu.models.dims import LEADER, RaftDims
+from raft_tla_tpu.models.invariants import Bounds, build_constraint
+from raft_tla_tpu.models.pystate import init_state
+from raft_tla_tpu.obs import (MetricsRegistry, RunEventLog,
+                              events_path, phase_delta,
+                              validate_run_events)
+
+DIMS = RaftDims(n_servers=3, n_values=2, max_log=4, n_msg_slots=32)
+BOUNDS = Bounds(max_term=2, max_log_len=1, max_msg_count=1)
+
+
+def small_config(**kw):
+    base = dict(batch=32, queue_capacity=1 << 12, seen_capacity=1 << 15,
+                check_deadlock=False)
+    base.update(kw)
+    return EngineConfig(**base)
+
+
+# ---------------------------------------------------------------------------
+# MetricsRegistry semantics
+
+def test_counters_accumulate_and_gauges_overwrite():
+    mt = MetricsRegistry()
+    mt.counter("a")
+    mt.counter("a", 4)
+    mt.gauge("g", 7)
+    mt.gauge("g", 3)
+    snap = mt.snapshot()
+    assert snap["counters"]["a"] == 5
+    assert snap["gauges"]["g"] == 3
+    assert mt.counter_value("a") == 5
+    assert mt.counter_value("missing") == 0
+
+
+def test_histogram_summary_and_buckets():
+    mt = MetricsRegistry()
+    for v in (0.001, 0.002, 0.004, 10.0):
+        mt.observe("h", v)
+    h = mt.snapshot()["histograms"]["h"]
+    assert h["count"] == 4
+    assert h["min"] == 0.001 and h["max"] == 10.0
+    assert abs(h["total"] - 10.007) < 1e-9
+    assert abs(h["mean"] - 10.007 / 4) < 1e-9
+    # 1-2-5 ladder: 0.001 -> "0.001" bucket, 0.002 -> "0.002",
+    # 0.004 -> "0.005", 10.0 -> "10"; counts sum to the observation count.
+    assert sum(h["buckets"].values()) == 4
+    assert h["buckets"]["0.001"] == 1 and h["buckets"]["0.005"] == 1
+
+
+def test_phase_timer_accumulates_into_phase_seconds():
+    mt = MetricsRegistry()
+    for _ in range(3):
+        with mt.phase_timer("stage"):
+            pass
+    ph = mt.phase_seconds()
+    assert set(ph) == {"stage"}
+    assert ph["stage"] >= 0.0
+    assert mt.snapshot()["histograms"]["phase/stage"]["count"] == 3
+    # phase_timer records even when the body raises (finally-path).
+    with pytest.raises(RuntimeError):
+        with mt.phase_timer("stage"):
+            raise RuntimeError("boom")
+    assert mt.snapshot()["histograms"]["phase/stage"]["count"] == 4
+
+
+def test_phase_delta_scopes_to_a_baseline():
+    mt = MetricsRegistry()
+    with mt.phase_timer("a"):
+        pass
+    base = mt.phase_seconds()
+    with mt.phase_timer("b"):
+        pass
+    d = phase_delta(mt.phase_seconds(), base)
+    assert "b" in d and "a" not in d     # a advanced by zero since base
+    assert phase_delta({"x": 1.0}, None) == {"x": 1.0}
+
+
+def test_registry_is_thread_safe():
+    mt = MetricsRegistry()
+
+    def work():
+        for _ in range(1000):
+            mt.counter("n")
+            mt.observe("h", 0.001)
+
+    threads = [threading.Thread(target=work) for _ in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert mt.counter_value("n") == 8000
+    assert mt.snapshot()["histograms"]["h"]["count"] == 8000
+
+
+# ---------------------------------------------------------------------------
+# RunEventLog + validation
+
+def test_event_log_writes_schema_lines(tmp_path):
+    p = str(tmp_path / "ev.jsonl")
+    with RunEventLog(p) as log:
+        assert log.enabled
+        log.emit("run_start", foo=1)
+        log.emit("run_end", bar="x")
+    recs = [json.loads(l) for l in open(p)]
+    assert [r["event"] for r in recs] == ["run_start", "run_end"]
+    for r in recs:
+        assert "ts" in r and "elapsed_seconds" in r
+    assert recs[0]["foo"] == 1 and recs[1]["bar"] == "x"
+    assert validate_run_events(p)[0]["event"] == "run_start"
+
+
+def test_event_log_null_sink_noops():
+    log = RunEventLog(None)
+    assert not log.enabled
+    log.emit("run_start")           # must not raise
+    log.close()
+
+
+def test_validate_rejects_missing_malformed_and_incomplete(tmp_path):
+    with pytest.raises(FileNotFoundError):
+        validate_run_events(str(tmp_path / "nope.jsonl"))
+    bad = tmp_path / "bad.jsonl"
+    bad.write_text('{"event": "run_start", "ts": 1}\nnot json\n')
+    with pytest.raises(ValueError, match="malformed"):
+        validate_run_events(str(bad))
+    partial = tmp_path / "partial.jsonl"
+    partial.write_text('{"event": "run_start", "ts": 1}\n')
+    with pytest.raises(ValueError, match="run_end"):
+        validate_run_events(str(partial))
+
+
+def test_events_path_resolution(tmp_path):
+    assert events_path(None, None) is None
+    assert events_path("/x/e.jsonl", "/ck") == "/x/e.jsonl"
+    assert events_path(None, "/ck") == os.path.join("/ck", "events.jsonl")
+    # Per-controller piece suffix under a process group.
+    assert events_path("/x/e.jsonl", None, 1, 4) == "/x/e.p1of4.jsonl"
+
+
+# ---------------------------------------------------------------------------
+# Engine integration (the acceptance contract)
+
+def run_and_load_events(tmp_path, engine_cls=BFSEngine, **cfg_kw):
+    ev = str(tmp_path / "events.jsonl")
+    eng = engine_cls(DIMS, constraint=build_constraint(DIMS, BOUNDS),
+                     config=small_config(max_diameter=3, events_out=ev,
+                                         **cfg_kw))
+    res = eng.run([init_state(DIMS)])
+    return res, validate_run_events(ev)
+
+
+def test_engine_run_emits_complete_event_log(tmp_path):
+    res, events = run_and_load_events(tmp_path)
+    kinds = [e["event"] for e in events]
+    assert kinds[0] == "run_start" and kinds[-1] == "run_end"
+    levels = [e for e in events if e["event"] == "level_complete"]
+    # Root-ingest level 0 plus the three expanded levels.
+    assert len(levels) == len(res.levels) == 4
+    assert [e["level"] for e in levels] == [0, 1, 2, 3]
+    assert [e["frontier_rows"] for e in levels] == res.levels
+    assert levels[-1]["distinct"] == res.distinct
+    # Phase accounting: cumulative per-phase seconds + the unattributed
+    # remainder == elapsed (exact by construction), AND the named phases
+    # cover most of the wall — the breakdown is real, not rounding dust.
+    last = levels[-1]
+    ph = last["phase_seconds"]
+    covered = sum(ph.values())
+    assert abs(covered + last["unattributed_seconds"]
+               - last["elapsed_seconds"]) < 0.05
+    assert covered >= 0.5 * last["elapsed_seconds"]
+    assert {"warmup", "chunk", "stats_fetch"} <= set(ph)
+    # run_end carries the final snapshot, mirrored on the result object.
+    end = events[-1]
+    assert end["stop_reason"] == "diameter_budget" == res.stop_reason
+    assert end["distinct"] == res.distinct
+    assert res.phases and set(ph) <= set(res.phases)
+
+
+def test_engine_metrics_registry_feeds_counters(tmp_path):
+    ev = str(tmp_path / "e.jsonl")
+    mt = MetricsRegistry()
+    eng = BFSEngine(DIMS, constraint=build_constraint(DIMS, BOUNDS),
+                    config=small_config(max_diameter=2, events_out=ev,
+                                        metrics=mt))
+    res = eng.run([init_state(DIMS)])
+    assert eng.metrics is mt     # shared registry honored
+    assert mt.counter_value("engine/distinct") == res.distinct
+    assert mt.counter_value("engine/generated") == res.generated
+    assert mt.snapshot()["gauges"]["engine/seen_size"] > 0
+
+
+def test_violation_event_and_depth0_replay(tmp_path):
+    # Mid-run violation -> a violation event in the log.
+    ev = str(tmp_path / "v.jsonl")
+    inv = {"NoLeader": lambda st: jnp.all(st.role != LEADER)}
+    eng = BFSEngine(DIMS, invariants=inv,
+                    constraint=build_constraint(DIMS, BOUNDS),
+                    config=small_config(events_out=ev))
+    s0 = init_state(DIMS).replace(
+        role=(1, 0, 0), current_term=(2, 2, 2), voted_for=(1, 1, 1),
+        votes_responded=(0b001, 0, 0), votes_granted=(0b001, 0, 0),
+        messages=frozenset({((1, 1, 0, 2, 1, ()), 1)}))
+    res = eng.run([s0])
+    assert res.stop_reason == "violation"
+    ev_kinds = [e["event"] for e in validate_run_events(ev)]
+    assert "violation" in ev_kinds
+
+    # Depth-0 violation (a root violates): replay() must return the
+    # one-state trace instead of raising KeyError (ADVICE r5 /
+    # mesh root-violation fix; same contract single-chip).
+    viol_root = init_state(DIMS).replace(role=(2, 0, 0))
+    eng2 = BFSEngine(DIMS, invariants=inv,
+                     constraint=build_constraint(DIMS, BOUNDS),
+                     config=small_config())
+    res2 = eng2.run([viol_root])
+    assert res2.stop_reason == "violation"
+    steps = eng2.replay(res2.violation.fingerprint)
+    assert steps == [(-1, viol_root)]
+
+
+def test_mesh_engine_emits_events_too(tmp_path):
+    from raft_tla_tpu.parallel.mesh import MeshBFSEngine
+    res, events = run_and_load_events(tmp_path, engine_cls=MeshBFSEngine,
+                                      batch=16)
+    kinds = [e["event"] for e in events]
+    assert kinds[0] == "run_start" and kinds[-1] == "run_end"
+    levels = [e for e in events if e["event"] == "level_complete"]
+    assert [e["frontier_rows"] for e in levels] == res.levels
+    assert res.phases and "stats_fetch" in res.phases
+
+
+def test_mesh_depth0_root_violation_replayable():
+    from raft_tla_tpu.parallel.mesh import MeshBFSEngine
+    inv = {"NoLeader": lambda st: jnp.all(st.role != LEADER)}
+    viol_root = init_state(DIMS).replace(role=(2, 0, 0))
+    eng = MeshBFSEngine(DIMS, invariants=inv,
+                        constraint=build_constraint(DIMS, BOUNDS),
+                        config=small_config(batch=16))
+    res = eng.run([viol_root])
+    assert res.stop_reason == "violation"
+    assert eng.replay(res.violation.fingerprint) == [(-1, viol_root)]
+
+
+# ---------------------------------------------------------------------------
+# CLI integration (--events-out / --metrics-out / --progress-interval)
+
+def test_cli_check_writes_events_and_metrics(tmp_path, capsys):
+    from raft_tla_tpu.cli import main as cli_main
+    here = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    ev = str(tmp_path / "cli_events.jsonl")
+    mo = str(tmp_path / "cli_metrics.json")
+    rc = cli_main([
+        "check", os.path.join(here, "configs/MCraft_bounded.cfg"),
+        "--engine", "single", "--batch", "64",
+        "--queue-capacity", str(1 << 12), "--seen-capacity", str(1 << 15),
+        "--max-diameter", "2", "--events-out", ev, "--metrics-out", mo,
+        "--progress-interval", "0"])
+    assert rc == 0
+    events = validate_run_events(ev)
+    kinds = [e["event"] for e in events]
+    assert kinds[0] == "run_start" and "level_complete" in kinds \
+        and kinds[-1] == "run_end"
+    snap = json.load(open(mo))
+    assert snap["counters"]["engine/distinct"] == 22   # pinned L2 prefix
+    assert any(k.startswith("phase/") for k in snap["histograms"])
+    out = capsys.readouterr().out
+    assert "distinct states    22" in out

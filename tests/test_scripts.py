@@ -68,3 +68,7 @@ def test_bench_runs_with_tiny_budget():
                      timeout=900)
     rec = json.loads(out.strip().splitlines()[-1])
     assert {"metric", "value", "unit", "vs_baseline"} <= set(rec)
+    # Telemetry (obs/): the per-phase wall-time breakdown BENCH_r06+
+    # carries; the script itself exits nonzero if the run's event log is
+    # missing or malformed, so reaching here also proves that gate.
+    assert rec["phases"] and "stats_fetch" in rec["phases"]

@@ -147,3 +147,34 @@ def test_bad_request(server):
     assert resp["ok"] is False
     resp = roundtrip(server, {"op": "check"})
     assert resp["ok"] is False and "cfg" in resp["error"]
+
+
+def test_stats_request_reports_requests_and_cache_counters(server):
+    """The live-stats endpoint (obs/): request counts, per-op latency
+    histograms, and LRU cache hit/miss counters.  Self-contained: two
+    identical checks guarantee >= 1 engine-cache hit regardless of what
+    ran before."""
+    base = {"op": "check",
+            "cfg": os.path.join(REPO, "configs/MCraft_bounded.cfg"),
+            "batch": 128, "max_diameter": 2,
+            "queue_capacity": 1 << 12, "seen_capacity": 1 << 15,
+            "check_deadlock": False}
+    r = roundtrip(server, base)
+    assert r["ok"]
+    # Per-run phase breakdown rides the check response too.
+    assert r["phases"] and "chunk" in r["phases"]
+    r = roundtrip(server, base)          # warm: engine-cache hit
+    assert r["ok"]
+    stats = roundtrip(server, {"op": "stats"})
+    assert stats["ok"] is True
+    counters = stats["metrics"]["counters"]
+    assert counters["server/requests/check"] >= 2
+    assert counters["server/engine_cache/hits"] >= 1
+    assert counters["server/engine_cache/misses"] >= 1
+    assert stats["engine_cache"]["size"] >= 1
+    assert stats["engine_cache"]["capacity"] == srv_mod._CACHE_CAP
+    # Latency histograms per op.
+    assert stats["metrics"]["histograms"]["phase/request/check"][
+        "count"] >= 2
+    # The stats op never takes the engine lock, and counts itself.
+    assert counters["server/requests/stats"] >= 1
