@@ -86,6 +86,28 @@ def main(argv=None):
                    help="min seconds between snapshots (snapshot cost is "
                         "O(seen states); 0 = every eligible level; "
                         "default 60)")
+    c.add_argument("--keep-checkpoints", type=int, default=None,
+                   help="retention: keep only the newest N intact "
+                        "snapshots/piece groups, deleting older ones "
+                        "after each successful write (default keep all)")
+    c.add_argument("--supervise", nargs="?", const=3, type=int,
+                   default=None, metavar="N",
+                   help="crash-resume supervisor (resilience/): run the "
+                        "check in a child process and, on a crash exit, "
+                        "resume it from the latest intact checkpoint "
+                        "with exponential backoff, up to N restarts "
+                        "(default 3).  Requires --checkpoint-dir (or the "
+                        "CHECKPOINT_DIR directive); emits 'restart' "
+                        "events into the JSONL event log")
+    c.add_argument("--fault-plan", default=None,
+                   help="deterministic fault injection (resilience/"
+                        "faults.py), e.g. 'ckpt_torn_write@level=3,"
+                        "kill@level=5,oom@grow=1'; FAULT_PLAN env is the "
+                        "fallback.  Testing/chaos only")
+    c.add_argument("--no-degrade", action="store_true",
+                   help="disable graceful OOM degradation (batch "
+                        "halving + checkpoint resume on "
+                        "RESOURCE_EXHAUSTED) — fail fast instead")
     c.add_argument("--resume", default=None,
                    help="checkpoint .npz to resume from, or 'auto' for the "
                         "latest one in --checkpoint-dir")
@@ -148,6 +170,46 @@ def main(argv=None):
     if platform:
         _force_platform(platform)
 
+    if args.cmd == "check" and args.supervise is not None:
+        # Crash-resume supervision (resilience/supervisor.py): re-run
+        # this same command in a child process, minus --supervise (the
+        # child checks; only the parent supervises) and --resume (the
+        # supervisor picks the resume point per attempt).
+        from .resilience.supervisor import (run_supervised,
+                                            strip_supervisor_flags)
+        ckdir, events_out = args.checkpoint_dir, args.events_out
+        if ckdir is None or events_out is None:
+            from .utils.cfg import parse_backend_directives
+            try:
+                with open(args.cfg) as f:
+                    be = parse_backend_directives(f.read())
+            except (OSError, ValueError):
+                be = {}
+            ckdir = ckdir if ckdir is not None else be.get("CHECKPOINT_DIR")
+            events_out = (events_out if events_out is not None
+                          else be.get("EVENTS_OUT"))
+        if not ckdir:
+            p.error("--supervise requires --checkpoint-dir (or a "
+                    "CHECKPOINT_DIR backend directive): crash-resume "
+                    "restarts from its snapshots")
+        raw = list(argv) if argv is not None else sys.argv[1:]
+        child = [sys.executable, "-m", "raft_tla_tpu"] \
+            + strip_supervisor_flags(raw)
+        # The user's own --resume is honored on the FIRST attempt; the
+        # supervisor owns the resume decision for restarts.
+        return run_supervised(child, ckdir, max_restarts=args.supervise,
+                              events_out=events_out,
+                              initial_resume=args.resume)
+
+    # Persistent compilation cache (utils/platform.py: per-host keyed):
+    # repeat CLI runs of the same model skip XLA compilation — which is
+    # what makes supervised crash-resume restarts cheap (each restart is
+    # a fresh process re-running the same programs).  Enabled below the
+    # supervise branch: the supervisor parent only spawns children and
+    # must not pay the jax import itself.
+    from .utils.platform import enable_persistent_cache
+    enable_persistent_cache()
+
     # Multi-host launch contract (parallel/multihost.py): export
     # RAFT_COORDINATOR / RAFT_NUM_PROCESSES / RAFT_PROCESS_ID and run the
     # SAME command on every host; the process group forms before any
@@ -204,11 +266,24 @@ def main(argv=None):
             checkpoint_interval_seconds=float(
                 resolve(args.checkpoint_interval,
                         "CHECKPOINT_INTERVAL", 60.0)),
+            keep_checkpoints=resolve(args.keep_checkpoints,
+                                     "KEEP_CHECKPOINTS", None),
             spill_dir=resolve(args.spill_dir, "SPILL_DIR", None),
             trace_dir=resolve(args.trace_dir, "TRACE_DIR", None),
             events_out=resolve(args.events_out, "EVENTS_OUT", None),
+            degrade_on_oom=not args.no_degrade,
             progress_interval_seconds=float(
                 resolve(args.progress_interval, "PROGRESS_SECONDS", 60.0)))
+        # Fault injection (resilience/): the --fault-plan flag or the
+        # FAULT_PLAN env a supervisor child inherits.  Fired markers
+        # default next to the checkpoints so a restarted child never
+        # re-fires a die-class fault at the same level forever.
+        from .resilience import faults as _faults
+        state_default = (os.path.join(cfgobj.checkpoint_dir,
+                                      ".fault_state")
+                         if cfgobj.checkpoint_dir else None)
+        _faults.install_from_env(default_state_dir=state_default,
+                                 text=args.fault_plan)
         engine_cls = args.engine if args.engine == "auto" else None
         if args.engine == "mesh":
             from .parallel.mesh import MeshBFSEngine

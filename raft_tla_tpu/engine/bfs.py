@@ -43,6 +43,7 @@ at batch granularity.
 from __future__ import annotations
 
 import dataclasses
+import os
 import time
 from typing import Callable, Dict, List, Optional, Tuple
 
@@ -59,6 +60,8 @@ from ..models.schema import (ROW_DTYPE, StateBatch, build_pack_guard,
                              flatten_state, state_width, unflatten_state)
 from ..obs import (MetricsRegistry, RunEventLog, device_memory_stats,
                    events_path, phase_delta)
+from ..resilience import faults as _faults
+from ..resilience.faults import is_resource_exhausted
 from ..ops import compact as compact_mod
 from ..ops import fpset
 from ..ops.fingerprint import build_fingerprint
@@ -138,6 +141,11 @@ class EngineConfig:
     trace_dir: Optional[str] = None
     checkpoint_every: int = 1             # snapshot every k levels...
     checkpoint_interval_seconds: float = 0.0  # ...but at most this often.
+    # Retention: after each successful snapshot, delete all but the
+    # newest N intact snapshots/piece groups (checkpoint.gc).  None/0 =
+    # keep all — the historical behavior; long supervised runs should
+    # set a small N so the states/ dir stays bounded.
+    keep_checkpoints: Optional[int] = None
     # Snapshot cost is O(seen states), so a per-level cadence is quadratic
     # over a long run; big runs should set a TLC-style time cadence (TLC
     # defaults to ~30 min between states/ checkpoints) and the CLI does.
@@ -162,6 +170,18 @@ class EngineConfig:
     # base plus a size-proportional allowance — the sibling of a large
     # local piece is probably still compressing its own.
     trace_merge_timeout_seconds: Optional[float] = None
+    # -- graceful degradation (resilience/) ----------------------------
+    # Catch RESOURCE_EXHAUSTED from the run (chunk dispatch, buffer
+    # allocation, seen-set growth): rebuild the engine at HALF the batch
+    # and continue from the newest intact snapshot (or from scratch when
+    # none exists) instead of aborting — the round-5 tunnel-wedge
+    # failure mode becomes a slow-but-correct run, recorded as a
+    # ``degraded`` obs event.  Halving stops at min_batch; multi-host
+    # process groups re-raise instead (one controller cannot rebuild
+    # alone while its siblings wait in collectives — crash-level
+    # recovery there is the supervisor's job).
+    degrade_on_oom: bool = True
+    min_batch: int = 32
 
 
 @dataclasses.dataclass
@@ -355,15 +375,21 @@ class BFSEngine:
         cfg = self.config
         # Telemetry spine (obs/): one registry per engine unless the
         # caller shares one; the event log is opened per run.
-        self.metrics = cfg.metrics or MetricsRegistry()
-        self._evlog = RunEventLog(None)
-        self._phase_base = {}
+        # ``_rebuild_at_batch`` re-enters __init__ MID-RUN (OOM
+        # degradation), so an existing registry and open event log must
+        # survive the re-init (parallel/mesh.py growth-path rule).
+        self.metrics = (cfg.metrics or getattr(self, "metrics", None)
+                        or MetricsRegistry())
+        if not hasattr(self, "_evlog"):
+            self._evlog = RunEventLog(None)
+            self._phase_base = {}
         if cfg.checkpoint_dir:
             # Fail at construction, not at the first level-boundary write.
             from . import checkpoint as _ckpt
             _ckpt.check_dims_checkpointable(dims)
         self.inv_names = list((invariants or {}).keys())
-        inv_fns = list((invariants or {}).values())
+        self._inv_fns = inv_fns = list((invariants or {}).values())
+        self._constraint = constraint
         expand = build_expand(dims)
         fingerprint = build_fingerprint(dims)
         pack_ok = build_pack_guard(dims)
@@ -571,8 +597,80 @@ class BFSEngine:
         events_out), brackets the run with run_start/run_end events, and
         scopes the per-phase wall-time breakdown to this run
         (``EngineResult.phases``) even on a warm, reused engine."""
-        return self._telemetry_run(self._run_impl, init_states,
+        return self._telemetry_run(self._run_degradable, init_states,
                                    resume=resume)
+
+    # ------------------------------------------------------------------
+    def _run_degradable(self, init_states, resume=None):
+        """Graceful degradation under resource exhaustion (resilience/):
+        retry ``_run_impl`` at half the batch when the device reports
+        RESOURCE_EXHAUSTED, continuing from the newest intact snapshot —
+        slow-but-correct instead of dead.  Shared with the mesh engine
+        via duck typing (``_rebuild_at_batch`` is per-class).
+
+        Restarting from a checkpoint is the only SAFE recovery: the
+        chunk/ingest programs donate the next-queue, seen-set, and trace
+        buffers, so after a failed dispatch the in-flight device state
+        is gone — a level-boundary snapshot (or the original roots) is
+        the nearest consistent image."""
+        from . import checkpoint as ckpt_mod
+        from ..parallel import multihost as mh
+        cfg = self.config
+        # Stale-dir guard (supervisor.py rule): snapshot names already in
+        # the dir belong to a PREVIOUS run unless the caller asked to
+        # resume — a fresh run must never degrade into a foreign image
+        # (load() validates only dims, not cfg/bounds).  Names, not
+        # contents: listdir is cheap enough to pay on every run.
+        user_resume = resume is not None
+        preexisting = (set(os.listdir(cfg.checkpoint_dir))
+                       if cfg.checkpoint_dir
+                       and os.path.isdir(cfg.checkpoint_dir) else set())
+        while True:
+            try:
+                return self._run_impl(init_states, resume=resume)
+            except Exception as e:
+                if not (cfg.degrade_on_oom and is_resource_exhausted(e)):
+                    raise
+                if mh.is_multiprocess():
+                    # One controller rebuilding alone would deadlock its
+                    # siblings' collectives; the supervisor restarts the
+                    # whole process group instead.
+                    raise
+                new_batch = self.config.batch // 2
+                if new_batch < max(1, cfg.min_batch):
+                    raise
+                ck = (ckpt_mod.latest(cfg.checkpoint_dir)
+                      if cfg.checkpoint_dir else None)
+                if ck is not None and not user_resume \
+                        and os.path.basename(ck) in preexisting:
+                    ck = None          # foreign snapshot: scratch restart
+                if ck is not None:
+                    resume = ck
+                elif resume is None and init_states is None:
+                    raise       # resumed run, snapshot gone: nothing left
+                self._evlog.emit(
+                    "degraded", reason="resource_exhausted",
+                    error=f"{type(e).__name__}: {str(e)[:300]}",
+                    batch=self.config.batch, new_batch=new_batch,
+                    resume_from=ck, memory=device_memory_stats())
+                self.metrics.counter("engine/degraded")
+                import sys as _sys
+                print(f"degraded: RESOURCE_EXHAUSTED; retrying at batch "
+                      f"{new_batch}"
+                      + (f", resuming {ck}" if ck else ""),
+                      file=_sys.stderr)
+                with self.metrics.phase_timer("degrade_rebuild"):
+                    self._rebuild_at_batch(new_batch)
+
+    def _rebuild_at_batch(self, new_batch: int) -> None:
+        """Recompile every program at a smaller batch (re-entrant
+        __init__, the parallel/mesh.py growth-path pattern); the open
+        event log / metrics registry survive."""
+        BFSEngine.__init__(
+            self, self.dims,
+            invariants=dict(zip(self.inv_names, self._inv_fns)),
+            constraint=self._constraint,
+            config=dataclasses.replace(self.config, batch=new_batch))
 
     def _telemetry_run(self, impl, init_states, resume=None):
         """Shared run_start/run_end bracketing (single-chip and mesh)."""
@@ -606,6 +704,9 @@ class BFSEngine:
                 distinct=getattr(res, "distinct", None),
                 generated=getattr(res, "generated", None),
                 diameter=getattr(res, "diameter", None),
+                # Full per-level frontier sizes: chaos_check.py compares
+                # supervised vs. uninterrupted runs on this field.
+                levels=list(getattr(res, "levels", None) or []),
                 wall_seconds=getattr(res, "wall_seconds", None),
                 growth_stalls=len(getattr(res, "growth_stalls", ())),
                 phase_seconds=phases, memory=device_memory_stats())
@@ -966,6 +1067,15 @@ class BFSEngine:
                             # by a whole sync_every chunk.
                             allowed = 1
                     calls_in_level += 1
+                    if _faults.ACTIVE:
+                        # Deterministic injection sites (resilience/):
+                        # "kill" dies here (mid-level, past the level's
+                        # snapshot), "oom" raises a simulated
+                        # RESOURCE_EXHAUSTED into the degradation path.
+                        _faults.fire("kill", level=res.diameter,
+                                     chunk=calls_in_level)
+                        _faults.fire("oom", level=res.diameter,
+                                     chunk=calls_in_level)
                     t_call = time.time()
                     with mt.phase_timer("chunk"):
                         out = self._chunk(qcur, jnp.int32(cur_count),
@@ -1206,7 +1316,35 @@ class BFSEngine:
         if (int(seen.size) if size is None else size) <= C // 2:
             return seen
         hi, lo = fpset.to_host_keys(seen)
-        return fpset.from_host_keys(hi, lo, 2 * C)
+        self._grow_attempts = getattr(self, "_grow_attempts", 0) + 1
+        try:
+            if _faults.ACTIVE:
+                _faults.fire("oom", grow=self._grow_attempts)
+            return fpset.from_host_keys(hi, lo, 2 * C)
+        except Exception as e:
+            if not (self.config.degrade_on_oom
+                    and is_resource_exhausted(e)):
+                raise
+            # Degraded growth retry: the keys are already host-resident,
+            # so the OLD device table can be released before the new
+            # allocation — the retry's peak is the new table alone
+            # instead of old + new.  (Capacities are power-of-two
+            # (ops/fpset.py masked indexing), so the "smaller factor"
+            # here is a smaller allocation PEAK, not a non-pow2 table.)
+            # A second failure propagates to _run_degradable, which
+            # halves the batch — shrinking queues and trace buffers —
+            # and resumes from the last intact snapshot.
+            self._evlog.emit(
+                "degraded", reason="oom_grow_retry", capacity=2 * C,
+                error=f"{type(e).__name__}: {str(e)[:300]}",
+                memory=device_memory_stats())
+            self.metrics.counter("engine/degraded")
+            for arr in (seen.hi, seen.lo):
+                try:
+                    arr.delete()
+                except Exception:
+                    pass
+            return fpset.from_host_keys(hi, lo, 2 * C)
 
     def _write_checkpoint(self, qcur, cur_count, pending, seen, res, trace,
                           wall):
@@ -1237,6 +1375,12 @@ class BFSEngine:
                                        f"level_{res.diameter:05d}.npz"), ck)
         finally:
             cleanup()
+        # Retention AFTER the successful write: the newest snapshot must
+        # land before any older one is considered surplus.
+        removed = ckpt_mod.gc(self.config.checkpoint_dir,
+                              self.config.keep_checkpoints)
+        if removed:
+            self.metrics.counter("engine/checkpoints_gcd", removed)
 
     def _record(self, trace, tr, n_new):
         if n_new == 0 or not self.config.record_trace:

@@ -73,6 +73,7 @@ def engine_config_from_backend(setup: CheckSetup) -> EngineConfig:
         checkpoint_interval_seconds=float(
             be.get("CHECKPOINT_INTERVAL",
                    EngineConfig.checkpoint_interval_seconds)),
+        keep_checkpoints=be.get("KEEP_CHECKPOINTS"),
         spill_dir=be.get("SPILL_DIR"),
         trace_dir=be.get("TRACE_DIR"),
         events_out=be.get("EVENTS_OUT"))

@@ -31,6 +31,7 @@ import numpy as np
 
 from ..models.dims import RaftDims
 from ..models.pystate import PyState
+from ..resilience import faults
 
 # v2: frontier rows are packed uint8 (v1 stored int32 rows with no value
 # bounds; loading them into the packed engine could wrap silently, so v1
@@ -92,9 +93,30 @@ class Checkpoint:
     roots: Dict[int, PyState]
 
 
+def _level_of(path: str) -> Optional[int]:
+    """BFS level encoded in a snapshot filename (single or piece), or
+    None for non-snapshot paths — fault-plan params match on it."""
+    name = os.path.basename(path)
+    m = _PIECE_RE.match(name)
+    if m:
+        return int(m.group(1)[len("level_"):])
+    if name.startswith("level_") and name.endswith(".npz"):
+        try:
+            return int(name[len("level_"):-len(".npz")])
+        except ValueError:
+            return None
+    return None
+
+
 def save(path: str, ckpt: Checkpoint) -> None:
     """Atomically write ``ckpt`` to ``path`` (a ``.npz`` file)."""
     from ..models.schema import state_width
+    if faults.ACTIVE:
+        m = _PIECE_RE.match(os.path.basename(path))
+        if faults.fire("ckpt_piece_missing", level=_level_of(path),
+                       piece=int(m.group(2)) if m else 0, path=path):
+            # Injected: this controller died before its piece landed.
+            return
     check_dims_checkpointable(ckpt.dims)
     cls_name = type(ckpt.dims).__name__
     meta = {
@@ -125,6 +147,10 @@ def save(path: str, ckpt: Checkpoint) -> None:
             roots=np.frombuffer(pickle.dumps(ckpt.roots), np.uint8))
         f.flush()
         os.fsync(f.fileno())     # the rename must never land a torn file
+    if faults.ACTIVE:
+        # The torn-write crash window: tmp is complete on disk, the
+        # rename has not happened — exactly what a power cut here leaves.
+        faults.fire("ckpt_torn_write", level=_level_of(path), path=path)
     os.replace(tmp, path)
     dfd = os.open(os.path.dirname(os.path.abspath(path)), os.O_RDONLY)
     try:
@@ -253,14 +279,9 @@ def _load_one(path: str) -> Checkpoint:
             roots=pickle.loads(bytes(z["roots"])))
 
 
-def latest(checkpoint_dir: str) -> Optional[str]:
-    """Path of the newest *readable* checkpoint in ``checkpoint_dir`` —
-    a single-file snapshot, or any piece of a COMPLETE multi-host piece
-    group (load() resolves the siblings).  Unreadable/truncated files
-    (e.g. a crash mid-write) and incomplete groups are skipped, falling
-    back to the next-newest intact snapshot."""
-    if not os.path.isdir(checkpoint_dir):
-        return None
+def _list_snapshots(checkpoint_dir: str):
+    """``[(level, [names])]`` of single snapshots and COMPLETE piece
+    groups in ``checkpoint_dir`` (no health check — callers decide)."""
     singles, groups = [], {}
     for name in os.listdir(checkpoint_dir):
         m = _PIECE_RE.match(name)
@@ -274,15 +295,80 @@ def latest(checkpoint_dir: str) -> Optional[str]:
                                 [name]))
             except ValueError:
                 continue
-    candidates = singles + [(lvl, sorted(names))
-                            for (lvl, nproc), names in groups.items()
-                            if len(names) == nproc]
-    for _lvl, names in sorted(candidates, reverse=True):
-        try:
-            for name in names:       # every piece must be intact
-                with np.load(os.path.join(checkpoint_dir, name)) as z:
-                    json.loads(bytes(z["meta"]).decode())
+    return singles + [(lvl, sorted(names))
+                      for (lvl, nproc), names in groups.items()
+                      if len(names) == nproc]
+
+
+def _group_is_intact(checkpoint_dir: str, names) -> bool:
+    """Every piece readable AND one run generation: pieces write their
+    psum-replicated counters into the metadata, so disagreement means
+    the group mixes pieces from different runs (a crash between piece
+    overwrites) — load() would raise on it, which is exactly the crash
+    pattern auto-resume exists for, so it must be skipped HERE."""
+    counters = set()
+    try:
+        for name in names:
+            with np.load(os.path.join(checkpoint_dir, name)) as z:
+                meta = json.loads(bytes(z["meta"]).decode())
+            counters.add((meta["distinct"], meta["generated"],
+                          meta["diameter"], tuple(meta["levels"])))
+    except Exception:
+        return False
+    return len(counters) == 1
+
+
+def latest(checkpoint_dir: str) -> Optional[str]:
+    """Path of the newest *resumable* checkpoint in ``checkpoint_dir`` —
+    a single-file snapshot, or any piece of a COMPLETE multi-host piece
+    group (load() resolves the siblings).  Unreadable/truncated files
+    (e.g. a crash mid-write), incomplete groups, and groups whose pieces
+    disagree on counters (mixed run generations — a crash between piece
+    overwrites) are skipped, falling back to the next-newest intact
+    snapshot."""
+    if not os.path.isdir(checkpoint_dir):
+        return None
+    for _lvl, names in sorted(_list_snapshots(checkpoint_dir),
+                              reverse=True):
+        if _group_is_intact(checkpoint_dir, names):
             return os.path.join(checkpoint_dir, names[0])
-        except Exception:
-            continue
     return None
+
+
+# Any file retention may touch: single/piece snapshots and their .tmp
+# leftovers.  Group 1 is the level — the only retention criterion.
+_SNAP_FILE_RE = re.compile(r"^level_(\d+)(?:\.p\d+of\d+)?\.npz(?:\.tmp)?$")
+
+
+def gc(checkpoint_dir: str, keep: Optional[int]) -> int:
+    """Retention: once ``keep`` intact snapshots/piece groups exist,
+    delete EVERY snapshot file strictly older than the oldest kept one —
+    surplus good snapshots, incomplete piece groups, and orphaned
+    ``.tmp`` leftovers of torn writes alike (crash debris is exactly
+    what a long supervised run accumulates).  Called by the engines
+    after each successful snapshot write (``EngineConfig.
+    keep_checkpoints``; None/0/negative = keep all).  Torn or
+    mixed-generation entries never count toward the ``keep`` quota —
+    retention must not evict the last good snapshot because garbage
+    outnumbers it — and nothing at or above the oldest kept level is
+    ever touched (a sibling controller may still be renaming its piece
+    of the newest group).  Returns the number of files removed."""
+    if not keep or keep < 0 or not os.path.isdir(checkpoint_dir):
+        return 0
+    intact = [lvl for lvl, names in sorted(_list_snapshots(checkpoint_dir),
+                                           reverse=True)
+              if _group_is_intact(checkpoint_dir, names)]
+    if len(intact) < keep:
+        return 0             # quota not yet filled: nothing is surplus
+    cutoff = intact[keep - 1]          # oldest kept level
+    removed = 0
+    for name in os.listdir(checkpoint_dir):
+        m = _SNAP_FILE_RE.match(name)
+        if m is None or int(m.group(1)) >= cutoff:
+            continue
+        try:
+            os.unlink(os.path.join(checkpoint_dir, name))
+            removed += 1
+        except OSError:
+            pass             # a sibling controller's gc got there first
+    return removed

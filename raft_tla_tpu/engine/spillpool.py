@@ -23,6 +23,8 @@ from typing import List, Optional
 
 import numpy as np
 
+from ..resilience import faults as _faults
+
 
 class SpillPool:
     """FIFO of row-array segments, RAM- or disk-backed."""
@@ -46,17 +48,38 @@ class SpillPool:
         if self.directory is None:
             self._ram.append(np.array(rows, copy=True) if copy else rows)
             return
-        fd, path = tempfile.mkstemp(
-            prefix=f"seg_{self._seq:06d}_", suffix=".rows",
-            dir=self.directory)
-        os.close(fd)
-        self._seq += 1
-        mm = np.memmap(path, dtype=rows.dtype, mode="w+",
-                       shape=rows.shape)
-        mm[:] = rows
-        mm.flush()
-        del mm                             # drop the writable mapping
-        self._files.append((path, rows.shape, rows.dtype))
+        # A spilled segment IS engine state — a lost write is a lost
+        # slice of the frontier, so a transient disk error (injectable:
+        # resilience/ "spill_write") gets one retry through a fresh
+        # tempfile before the failure is allowed to surface.
+        last_err = None
+        for attempt in (1, 2):
+            path = None
+            try:
+                if _faults.ACTIVE:
+                    _faults.fire("spill_write", attempt=attempt)
+                fd, path = tempfile.mkstemp(
+                    prefix=f"seg_{self._seq:06d}_", suffix=".rows",
+                    dir=self.directory)
+                os.close(fd)
+                self._seq += 1
+                mm = np.memmap(path, dtype=rows.dtype, mode="w+",
+                               shape=rows.shape)
+                mm[:] = rows
+                mm.flush()
+                del mm                     # drop the writable mapping
+                self._files.append((path, rows.shape, rows.dtype))
+                return
+            except OSError as e:
+                last_err = e
+                if path is not None:
+                    try:
+                        os.unlink(path)    # never leave a torn segment
+                    except OSError:
+                        pass
+        raise OSError(
+            f"spill segment write failed twice in {self.directory!r} "
+            f"({len(rows)} rows): {last_err}") from last_err
 
     # -- readers -------------------------------------------------------
     def pop(self, index: int = 0) -> np.ndarray:

@@ -67,6 +67,7 @@ from ..obs import MetricsRegistry, RunEventLog, events_path
 from ..ops import compact as compact_mod
 from ..ops import fpset
 from ..ops.fingerprint import SENTINEL, build_fingerprint
+from ..resilience import faults as _faults
 
 _I32 = jnp.int32
 _U32 = jnp.uint32
@@ -458,10 +459,27 @@ class MeshBFSEngine:
             resume=None) -> EngineResult:
         """Telemetry wrapper (engine/bfs.py rationale): run_start/run_end
         events bracket the run, phases are scoped to it.  Shared via duck
-        typing, like replay()."""
+        typing, like replay() — as is the OOM degradation wrapper
+        (single-controller only; a process group re-raises and the
+        supervisor restarts the whole fleet)."""
         from ..engine.bfs import BFSEngine
-        return BFSEngine._telemetry_run(self, self._run_impl, init_states,
+
+        def impl(states, resume=None):
+            return BFSEngine._run_degradable(self, states, resume=resume)
+
+        return BFSEngine._telemetry_run(self, impl, init_states,
                                         resume=resume)
+
+    def _rebuild_at_batch(self, new_batch: int) -> None:
+        """Recompile the mesh programs at a smaller batch (the re-entrant
+        __init__ path growth already uses); registry/event log survive."""
+        import dataclasses as _dc
+        MeshBFSEngine.__init__(
+            self, self.dims,
+            invariants=dict(zip(self.inv_names, self._inv_fns)),
+            constraint=self._constraint,
+            config=_dc.replace(self.config, batch=new_batch),
+            devices=list(self.mesh.devices.ravel()))
 
     def _events_path(self):
         """One event-log piece per controller (multi-host checkpoint
@@ -867,6 +885,14 @@ class MeshBFSEngine:
                             res.stop_reason = "duration_budget"
                             break
                     calls_in_level += 1
+                    if _faults.ACTIVE:
+                        # Same deterministic sites as the single-chip
+                        # loop (resilience/): mid-level kill and
+                        # simulated RESOURCE_EXHAUSTED.
+                        _faults.fire("kill", level=res.diameter,
+                                     chunk=calls_in_level)
+                        _faults.fire("oom", level=res.diameter,
+                                     chunk=calls_in_level)
                     t_call = time.time()
                     with mt.phase_timer("chunk"):
                         out = self._chunk(
@@ -1075,6 +1101,12 @@ class MeshBFSEngine:
         the packed stats), so every controller takes the same branch."""
         if max_ssize <= self._CL // 2:
             return shi, slo, ssize
+        self._grow_attempts = getattr(self, "_grow_attempts", 0) + 1
+        if _faults.ACTIVE:
+            # A growth OOM here propagates to the shared degradation
+            # wrapper (halve batch + resume); the per-shard rebuild has
+            # no safe mid-way retry point, unlike the single-chip table.
+            _faults.fire("oom", grow=self._grow_attempts)
         return self._grow_seen(shi, slo, ssize)
 
     def _grow_precompiled(self, shi, slo, ssize, qcur, qnext, next_counts,
@@ -1166,6 +1198,14 @@ class MeshBFSEngine:
             ckpt_mod.save(path, ck)
         finally:
             front_cleanup()
+        # Retention after the successful write (engine/bfs.py rule).
+        # Under a process group every controller runs the same gc over
+        # the shared dir; deletions race benignly (missing files are
+        # skipped) and only complete intact groups count toward keep.
+        removed = ckpt_mod.gc(self.config.checkpoint_dir,
+                              self.config.keep_checkpoints)
+        if removed:
+            self.metrics.counter("engine/checkpoints_gcd", removed)
 
     def _flush_trace(self, trace, tbuf, tcount):
         """Harvest trace records from this controller's ADDRESSABLE chip
@@ -1217,6 +1257,11 @@ class MeshBFSEngine:
         which record_trace under a process group therefore requires
         (``trace_dir``, defaulting to ``checkpoint_dir``)."""
         tf, tp, ta = trace.export()
+        if _faults.ACTIVE:
+            # Injected slow sibling: exercises _merge_trace_pieces'
+            # poll/deadline path without needing a genuinely slow host.
+            _faults.fire("trace_piece_delay",
+                         piece=jax.process_index())
         d = self._trace_exchange_dir
         os.makedirs(d, exist_ok=True)
         path = self._trace_piece_path(
@@ -1282,6 +1327,11 @@ class MeshBFSEngine:
                 unflatten_state(np.asarray(vrow), self.dims), self.dims),
             fingerprint=(int(vf[0]) << 32) | int(vf[1]))
         res.stop_reason = "violation"
+        # Same event every other violation path emits — consumers filter
+        # on event=="violation" for the counterexample record.
+        self._evlog.emit("violation", invariant=res.violation.invariant,
+                         fingerprint=hex(res.violation.fingerprint),
+                         level=0)
         return True
 
     # Replay shares the single-engine mechanism.  Under a process group

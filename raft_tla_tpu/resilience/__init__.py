@@ -1,0 +1,33 @@
+"""Resilience subsystem — fault injection, crash-resume, degradation.
+
+TLC's whole crash story is its ``states/`` directory; this package is the
+layer that *exercises* ours.  Three parts, one recovery spine:
+
+- :mod:`.faults` — a deterministic :class:`FaultPlan` (``--fault-plan`` /
+  ``FAULT_PLAN`` env) with named injection sites threaded through
+  ``engine/checkpoint.py`` (torn write), ``engine/bfs.py`` (mid-level
+  kill, simulated RESOURCE_EXHAUSTED), ``engine/spillpool.py`` (failed
+  spill write) and ``parallel/mesh.py`` (delayed trace piece).  Zero
+  overhead when no plan is installed (sites guard on a module bool).
+- :mod:`.supervisor` — ``cli check --supervise[=N]``: run the check in a
+  child process and, on a crash exit, resume from
+  ``checkpoint.latest()`` with bounded restarts and exponential
+  backoff, emitting ``restart`` events into the run's JSONL log.
+- graceful degradation lives in the engines themselves
+  (``engine/bfs.py``): RESOURCE_EXHAUSTED from the chunk loop or a
+  seen-set growth is caught, the batch halves (down to
+  ``EngineConfig.min_batch``) or the growth retries after releasing the
+  old table, and the run continues from its last intact snapshot —
+  recorded as a ``degraded`` event instead of an abort.
+
+``scripts/chaos_check.py`` is the end-to-end harness: a supervised run
+under a fault plan must finish bit-identical to an uninterrupted one.
+"""
+
+# NOTE: faults.ACTIVE is deliberately NOT re-exported — a ``from ...
+# import ACTIVE`` would freeze the bool at import time; injection sites
+# must read the live ``faults.ACTIVE`` module attribute.
+from .faults import (EXIT_FAULT, FaultInjected,              # noqa: F401
+                     FaultPlan, SimulatedResourceExhausted, clear, fire,
+                     install, install_from_env, is_resource_exhausted)
+from .supervisor import run_supervised                        # noqa: F401

@@ -296,8 +296,48 @@ def handle_request(req: dict) -> dict:
 
 
 class _Handler(socketserver.StreamRequestHandler):
+    """Connection hardening (resilience): the service is long-lived, so a
+    single connection must not be able to take it down or pin it —
+
+    - the request LINE is size-bounded (``max_request_bytes``): the
+      newline-delimited protocol otherwise buffers an arbitrarily long
+      line in RAM before json parsing ever sees it, so one huge line
+      could OOM the whole warm-engine process;
+    - the socket gets an IDLE timeout (``idle_timeout_seconds``): a dead
+      or wedged client would otherwise hold its handler thread (and its
+      open fd) forever.  The timeout covers reads between requests and
+      response writes — a check/simulate in flight does not tick it,
+      because the handler is computing, not blocked on the socket.
+
+    The oversized reject answers ``{"ok": false}`` (the client is
+    mid-exchange and waiting for a line) and then closes — an oversized
+    line cannot be resynced, its remainder would parse as garbage
+    requests.  The idle timeout closes SILENTLY: the client is between
+    requests, and an unsolicited error line sitting in the socket
+    buffer would be misread as the response to whatever it sends next
+    from a stale pooled connection."""
+
     def handle(self):
-        for line in self.rfile:
+        srv = self.server
+        try:
+            self.connection.settimeout(srv.idle_timeout_seconds)
+        except OSError:
+            pass
+        while True:
+            try:
+                line = self.rfile.readline(srv.max_request_bytes + 1)
+            except (TimeoutError, OSError):
+                _METRICS.counter("server/rejected/idle_timeout")
+                return       # silent close: see class docstring
+            if not line:
+                return
+            if len(line) > srv.max_request_bytes:
+                _METRICS.counter("server/rejected/oversized")
+                self._try_respond({
+                    "ok": False,
+                    "error": f"request line exceeds "
+                             f"{srv.max_request_bytes} bytes"})
+                return
             line = line.strip()
             if not line:
                 continue
@@ -307,19 +347,40 @@ class _Handler(socketserver.StreamRequestHandler):
                 resp = {"ok": False, "error": f"bad json: {e}"}
             else:
                 resp = handle_request(req)
+            if not self._try_respond(resp):
+                return
+
+    def _try_respond(self, resp: dict) -> bool:
+        """Best-effort one-line reply; False when the client is gone (a
+        failed write must end the handler, never crash the thread)."""
+        try:
             self.wfile.write((json.dumps(resp) + "\n").encode())
             self.wfile.flush()
+            return True
+        except (TimeoutError, OSError):
+            _METRICS.counter("server/rejected/dead_client")
+            return False
 
 
 class CheckerServer(socketserver.ThreadingTCPServer):
     allow_reuse_address = True
     daemon_threads = True
+    # Hardening knobs (see _Handler): overridable per instance/CLI.
+    max_request_bytes = 10 << 20       # a sane cfg_text is far smaller
+    idle_timeout_seconds = 300.0
 
 
-def serve(host: str = "127.0.0.1", port: int = 8610) -> CheckerServer:
+def serve(host: str = "127.0.0.1", port: int = 8610,
+          max_request_bytes: Optional[int] = None,
+          idle_timeout_seconds: Optional[float] = None) -> CheckerServer:
     """Create (and return) a listening server; caller decides threading.
     Port 0 picks an ephemeral port (see ``server_address[1]``)."""
-    return CheckerServer((host, port), _Handler)
+    srv = CheckerServer((host, port), _Handler)
+    if max_request_bytes is not None:
+        srv.max_request_bytes = max_request_bytes
+    if idle_timeout_seconds is not None:
+        srv.idle_timeout_seconds = idle_timeout_seconds
+    return srv
 
 
 def main(argv=None):
@@ -329,11 +390,20 @@ def main(argv=None):
     p.add_argument("--port", type=int, default=8610)
     p.add_argument("--platform", default=None,
                    help="jax platform override (e.g. cpu)")
+    p.add_argument("--max-request-bytes", type=int, default=None,
+                   help="reject request lines larger than this "
+                        f"(default {CheckerServer.max_request_bytes})")
+    p.add_argument("--idle-timeout", type=float, default=None,
+                   help="drop connections idle longer than this many "
+                        "seconds "
+                        f"(default {CheckerServer.idle_timeout_seconds})")
     args = p.parse_args(argv)
     if args.platform == "cpu":
         from .utils.platform import force_cpu
         force_cpu()
-    srv = serve(args.host, args.port)
+    srv = serve(args.host, args.port,
+                max_request_bytes=args.max_request_bytes,
+                idle_timeout_seconds=args.idle_timeout)
     print(f"raft_tla_tpu checker service on "
           f"{srv.server_address[0]}:{srv.server_address[1]}")
     try:

@@ -30,7 +30,7 @@ comments, a backend-annotated cfg still parses and runs under stock TLC
 unchanged — the cfg stays the single source of truth for both engines.
 Recognized keys: BATCH, QUEUE_CAPACITY, SEEN_CAPACITY, N_MSG_SLOTS,
 MAX_LOG, PLATFORM, CHECKPOINT_DIR, CHECKPOINT_EVERY, CHECKPOINT_INTERVAL,
-SPILL_DIR, TRACE_DIR, PROGRESS_SECONDS, EVENTS_OUT.
+SPILL_DIR, TRACE_DIR, PROGRESS_SECONDS, EVENTS_OUT, KEEP_CHECKPOINTS.
 Precedence everywhere: CLI flag > cfg backend key > built-in default.
 """
 
@@ -79,6 +79,7 @@ _BACKEND_KEYS = {
     "BATCH", "QUEUE_CAPACITY", "SEEN_CAPACITY", "N_MSG_SLOTS", "MAX_LOG",
     "PLATFORM", "CHECKPOINT_DIR", "CHECKPOINT_EVERY", "CHECKPOINT_INTERVAL",
     "SPILL_DIR", "TRACE_DIR", "PROGRESS_SECONDS", "EVENTS_OUT",
+    "KEEP_CHECKPOINTS",
 }
 
 
