@@ -1,0 +1,81 @@
+"""Static model analysis — proofs and lints that never run the state space.
+
+Every action kernel is a pure, statically-shaped JAX function over the
+packed ``StateBatch`` encoding, so the *model itself* is analyzable at
+trace time.  Three passes share one jaxpr evaluator (``interp.py``) and
+one findings/report spine (``report.py``):
+
+- :mod:`.effects` — per-action read/write sets from the kernel jaxprs:
+  the action dependence matrix (which instances provably commute — the
+  fact partial-order reduction and BLEST-style tensor-core batching
+  need), guard-independence, and dead packed lanes;
+- :mod:`.bounds` — interval abstract interpretation of every kernel to
+  a reachable-envelope fixpoint: proves each packed lane wide enough
+  (or names the witness action that overflows it) and flags int32 wrap,
+  turning ``schema.audit_lane_widths``/``check_packable`` from runtime
+  guards into trace-time proofs;
+- :mod:`.lint` — TPU-throughput hazards in the compiled BFS step /
+  fingerprint / FPSet kernels (host callbacks, dynamic shapes,
+  non-deterministic reductions, accidental narrowing) plus an AST check
+  that the host chunk loop only blocks on device data at sanctioned
+  sync points.
+
+``run_analysis`` executes the passes and aggregates one
+:class:`~.report.Report`; the ``analyze`` CLI subcommand and the CI
+gate consume its JSON (README "Static analysis").  Findings feed the
+telemetry spine (obs/): an ``analysis`` run event per pass and
+``analysis/errors`` / ``analysis/warnings`` counters.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+from .report import ERROR, INFO, Report, WARNING  # noqa: F401
+
+#: Pass registry, in execution order.
+PASSES = ("effects", "bounds", "lint")
+
+
+def run_analysis(dims, bounds=None, init_states=None,
+                 passes=PASSES, allowlist: Optional[List[str]] = None,
+                 lane_caps=None, lint_targets=None,
+                 metrics=None, evlog=None) -> Report:
+    """Run the requested passes over one model.
+
+    ``bounds`` is the cfg's CONSTRAINT bounds (models/invariants.Bounds),
+    ``init_states`` concrete roots to seed the bounds fixpoint (None or
+    randomized-smoke roots fall back to the declared domain envelope),
+    ``lane_caps``/``lint_targets`` are test/fixture overrides passed to
+    their passes.  ``metrics`` (MetricsRegistry) and ``evlog``
+    (RunEventLog) receive the per-pass telemetry when given."""
+    report = Report(model={"dims": repr(dims),
+                           "model_class": type(dims).__name__},
+                    allowlist=allowlist)
+    for name in passes:
+        if name == "effects":
+            from . import effects
+            summary, findings = effects.analyze(dims)
+            summary = effects.summary_json(summary)
+        elif name == "bounds":
+            from . import bounds as bounds_mod
+            summary, findings = bounds_mod.analyze(
+                dims, bounds=bounds, init_states=init_states,
+                lane_caps=lane_caps)
+        elif name == "lint":
+            from . import lint
+            summary, findings = lint.analyze(dims, targets=lint_targets)
+        else:
+            raise ValueError(f"unknown analysis pass {name!r}; "
+                             f"registered: {PASSES}")
+        report.extend(findings)
+        report.summarize_pass(name, summary)
+        counts = report.severity_counts(name)
+        if metrics is not None:
+            metrics.counter("analysis/errors", counts[ERROR])
+            metrics.counter("analysis/warnings", counts[WARNING])
+        if evlog is not None:
+            evlog.emit("analysis", pass_name=name,
+                       severity_counts=counts,
+                       witness=report.first_witness(name))
+    return report

@@ -1,0 +1,222 @@
+"""Effect extraction: per-action read/write sets from the kernel jaxprs.
+
+Each action-family kernel is traced once (``interp.trace_family``) and
+re-evaluated per instance under the taint domain with that instance's
+concrete parameters.  The result, per action instance:
+
+- ``guard_reads`` — fields the ``enabled`` predicate depends on;
+- ``reads``      — fields any non-identity output depends on (guards,
+  overflow, and every written field's new value);
+- ``writes``     — per written field, the element-wise mask of lanes
+  that can differ from the parent state (exact down to the instance's
+  own server row where the kernel's index masks are parameter-concrete;
+  conservatively whole-field where the write target is state-dependent,
+  e.g. ``Receive``'s reply slot).
+
+From these the pass derives the action dependence matrix (instances
+whose effects provably commute at this granularity), the provably
+independent guard/effect pairs POR-style optimizations need, and the
+dead-lane check (state elements no action ever writes).  Everything is
+sound w.r.t. the traced kernels: an unhandled primitive degrades to
+"may read/write everything it touched" and is reported, never dropped.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, FrozenSet, List, Tuple
+
+import numpy as np
+
+from . import lane_map
+from .interp import TaintDomain, Taint, _taint, eval_jaxpr, traced_kernels
+from .report import Finding, INFO, WARNING
+
+PASS = "effects"
+
+
+@dataclasses.dataclass
+class InstanceEffect:
+    grid_index: int
+    family: str
+    label: str
+    guard_reads: FrozenSet[str]
+    reads: FrozenSet[str]
+    writes: Dict[str, np.ndarray]       # field -> bool mask (field shape)
+
+    @property
+    def write_fields(self) -> FrozenSet[str]:
+        return frozenset(self.writes)
+
+
+@dataclasses.dataclass
+class EffectSummary:
+    instances: List[InstanceEffect]
+    #: family -> {"reads", "writes", "guard_reads"} field-name sets.
+    families: Dict[str, Dict[str, FrozenSet[str]]]
+    #: [G, G] bool — True where the two instances provably commute at
+    #: this granularity (disjoint writes, and neither writes what the
+    #: other reads).
+    independent: np.ndarray
+    #: [G, G] bool — True where neither instance writes a field the
+    #: other's GUARD reads (enabledness commutes; the weaker relation
+    #: partial-order reduction needs).
+    guard_independent: np.ndarray
+    #: field -> bool mask of elements written by no action instance.
+    dead_lanes: Dict[str, np.ndarray]
+
+
+def _state_taints(dims) -> List[Taint]:
+    shapes = lane_map.field_shapes(dims)
+    out = []
+    for f in lane_map.FIELDS:
+        shp = shapes[f]
+        out.append(_taint(frozenset({f}), f, np.zeros(shp, bool),
+                          np.zeros(shp, bool), np.zeros(shp, np.int64),
+                          np.int32))
+    return out
+
+
+def analyze(dims) -> Tuple[EffectSummary, List[Finding]]:
+    """Run effect extraction over the full action-instance grid."""
+    kernels = traced_kernels(dims)
+    assert tuple(k[0] for k in kernels) == dims.family_names
+    findings: List[Finding] = []
+    domain = TaintDomain()
+    state = _state_taints(dims)
+    instances: List[InstanceEffect] = []
+
+    for (name, closed, params), off in zip(kernels, dims.family_offsets):
+        grids = np.stack([np.asarray(p) for p in params], axis=-1) \
+            if params else np.zeros((1, 0), np.int64)
+        for k in range(grids.shape[0]):
+            g = off + k
+            args = state + [np.int32(v) for v in grids[k]]
+            outs = eval_jaxpr(closed, args, domain)
+            en, ovf = outs[0], outs[1]
+            succ = outs[2:]
+            writes: Dict[str, np.ndarray] = {}
+            reads = set(en.deps) | set(ovf.deps)
+            for f, out in zip(lane_map.FIELDS, succ):
+                mask = out.diff if out.origin == f \
+                    else np.ones(out.shape, bool)
+                if mask.any():
+                    writes[f] = mask
+                    reads |= out.deps
+            instances.append(InstanceEffect(
+                grid_index=g, family=name,
+                label=dims.describe_instance(g),
+                guard_reads=frozenset(en.deps),
+                reads=frozenset(reads), writes=writes))
+
+    families: Dict[str, Dict[str, FrozenSet[str]]] = {}
+    for inst in instances:
+        fam = families.setdefault(
+            inst.family, {"reads": frozenset(), "writes": frozenset(),
+                          "guard_reads": frozenset()})
+        fam["reads"] |= inst.reads
+        fam["writes"] |= inst.write_fields
+        fam["guard_reads"] |= inst.guard_reads
+
+    independent, guard_independent = _dependence_matrices(instances)
+    dead = _dead_lanes(dims, instances)
+    for f, mask in dead.items():
+        if mask.all():
+            findings.append(Finding(
+                PASS, WARNING, "dead-field", field=f,
+                message=f"state field {f!r} is written by no action "
+                        "instance — a dead lane in the packed encoding"))
+        elif mask.any():
+            findings.append(Finding(
+                PASS, INFO, "dead-lanes", field=f,
+                message=f"{int(mask.sum())}/{mask.size} elements of "
+                        f"field {f!r} are written by no action instance",
+                details={"unwritten": int(mask.sum())}))
+    for note in domain.notes:
+        findings.append(Finding(
+            PASS, INFO, "analysis-imprecision",
+            message=f"taint analysis fell back to a conservative rule "
+                    f"({note}); read/write sets remain sound but may "
+                    "over-approximate"))
+    return (EffectSummary(instances=instances, families=families,
+                          independent=independent,
+                          guard_independent=guard_independent,
+                          dead_lanes=dead),
+            findings)
+
+
+def _dependence_matrices(instances) -> Tuple[np.ndarray, np.ndarray]:
+    G = len(instances)
+    indep = np.zeros((G, G), bool)
+    gindep = np.zeros((G, G), bool)
+    for a in range(G):
+        ia = instances[a]
+        for b in range(a, G):
+            ib = instances[b]
+            # Full independence: element-disjoint writes AND neither
+            # writes a field the other reads (field granularity for
+            # reads — conservative).
+            ok = True
+            for f, m in ia.writes.items():
+                if f in ib.reads:
+                    ok = False
+                    break
+                mb = ib.writes.get(f)
+                if mb is not None and bool((m & mb).any()):
+                    ok = False
+                    break
+            if ok:
+                for f in ib.writes:
+                    if f in ia.reads:
+                        ok = False
+                        break
+            indep[a, b] = indep[b, a] = ok and a != b
+            gok = not (ia.write_fields & ib.guard_reads) \
+                and not (ib.write_fields & ia.guard_reads)
+            gindep[a, b] = gindep[b, a] = gok and a != b
+    return indep, gindep
+
+
+def _dead_lanes(dims, instances) -> Dict[str, np.ndarray]:
+    shapes = lane_map.field_shapes(dims)
+    written = {f: np.zeros(shapes[f], bool) for f in lane_map.FIELDS}
+    for inst in instances:
+        for f, m in inst.writes.items():
+            written[f] |= m
+    return {f: ~w for f, w in written.items()}
+
+
+def summary_json(summary: EffectSummary) -> dict:
+    """Compact JSON view: per-family sets, matrix statistics, and the
+    family-level independent pairs (the full G x G matrix is returned by
+    :func:`analyze` for programmatic use, not serialized)."""
+    fams = {name: {k: sorted(v) for k, v in d.items()}
+            for name, d in summary.families.items()}
+    G = len(summary.instances)
+    pairs = G * (G - 1) // 2
+    fam_of = [i.family for i in summary.instances]
+    fam_names = sorted({f for f in fam_of})
+    fam_indep = []
+    for i, fa in enumerate(fam_names):
+        for fb in fam_names[i:]:
+            idx_a = [k for k, f in enumerate(fam_of) if f == fa]
+            idx_b = [k for k, f in enumerate(fam_of) if f == fb]
+            sub = summary.independent[np.ix_(idx_a, idx_b)]
+            if fa == fb:
+                if len(idx_a) > 1 and bool(
+                        sub[~np.eye(len(idx_a), dtype=bool)].all()):
+                    fam_indep.append([fa, fb])
+            elif bool(sub.all()):
+                fam_indep.append([fa, fb])
+    return {
+        "n_instances": G,
+        "families": fams,
+        "independent_pairs": int(np.triu(summary.independent, 1).sum()),
+        "guard_independent_pairs": int(
+            np.triu(summary.guard_independent, 1).sum()),
+        "total_pairs": pairs,
+        "independent_family_pairs": fam_indep,
+        "dead_lane_counts": {f: int(m.sum())
+                             for f, m in summary.dead_lanes.items()
+                             if m.any()},
+    }

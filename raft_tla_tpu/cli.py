@@ -40,6 +40,61 @@ def _write_metrics(path: str, registry) -> None:
     os.replace(tmp, path)
 
 
+def _run_analyze(args) -> int:
+    """``analyze``: run the static-analysis passes (analysis/) over one
+    model and gate on ERROR findings — the per-PR kernel-correctness
+    gate CI runs.  Exit 0 iff no (un-allowlisted) ERROR finding."""
+    import json
+
+    from .analysis import run_analysis
+    from .analysis.lane_map import FIELDS
+    from .obs import MetricsRegistry, RunEventLog
+
+    if args.cfg is not None:
+        from .engine.check import initial_states
+        from .utils.cfg import load_config
+        setup = load_config(args.cfg, max_log=args.max_log,
+                            n_msg_slots=args.n_msg_slots)
+        dims, bounds = setup.dims, setup.bounds
+        # Randomized smoke roots say nothing about the reachable set;
+        # the bounds pass then seeds from the declared domain envelope.
+        roots = None if setup.smoke else initial_states(setup)
+    else:
+        from .models.dims import RaftDims
+        from .models.pystate import init_state
+        dims = RaftDims(n_servers=3, n_values=2,
+                        max_log=args.max_log or 8,
+                        n_msg_slots=args.n_msg_slots or 32)
+        bounds, roots = None, [init_state(dims)]
+
+    lane_caps = {}
+    for spec in args.shrink_lane:
+        field, _, hi = spec.partition("=")
+        if field not in FIELDS or not hi.lstrip("-").isdigit():
+            raise SystemExit(
+                f"--shrink-lane wants FIELD=HI with FIELD in {FIELDS}, "
+                f"got {spec!r}")
+        lane_caps[field] = (0, int(hi))
+
+    passes = tuple(args.passes.split(",")) if args.passes else None
+    metrics = MetricsRegistry()
+    with RunEventLog(args.events_out) as evlog:
+        report = run_analysis(
+            dims, bounds=bounds, init_states=roots,
+            **({"passes": passes} if passes else {}),
+            allowlist=args.allow, lane_caps=lane_caps or None,
+            metrics=metrics, evlog=evlog)
+    if args.out:
+        report.write_json(args.out)
+    if args.json:
+        print(json.dumps(report.to_json(), indent=2, sort_keys=True))
+    else:
+        print(report.render_text())
+    if args.metrics_out:
+        _write_metrics(args.metrics_out, metrics)
+    return 0 if report.ok else 1
+
+
 def _force_platform(platform: str):
     if platform == "cpu":
         from .utils.platform import force_cpu
@@ -136,6 +191,44 @@ def main(argv=None):
                         "(counters/gauges/histograms JSON) here after "
                         "the run")
 
+    a = sub.add_parser(
+        "analyze",
+        help="static model analysis (no state-space run): jaxpr effect "
+             "extraction, interval lane-overflow proofs, hot-loop lint")
+    a.add_argument("cfg", nargs="?", default=None,
+                   help="TLC .cfg file; omitted = the seed model "
+                        "(3 servers, 2 values, no CONSTRAINT bounds)")
+    a.add_argument("--platform", default=None,
+                   help="jax platform (default cpu — analysis only "
+                        "traces, it never runs the device)")
+    a.add_argument("--n-msg-slots", type=int, default=None)
+    a.add_argument("--max-log", type=int, default=None)
+    a.add_argument("--json", action="store_true",
+                   help="print the machine-readable report to stdout "
+                        "instead of the text rendering")
+    a.add_argument("--out", default=None, metavar="FILE",
+                   help="also write the JSON report here (the CI "
+                        "artifact)")
+    a.add_argument("--allow", action="append", default=[],
+                   metavar="CODE[:QUALIFIER]",
+                   help="downgrade matching ERROR findings to WARNING "
+                        "(kept visible, marked allowlisted; README "
+                        "'Static analysis')")
+    a.add_argument("--passes", default=None,
+                   help="comma-separated subset of effects,bounds,lint "
+                        "(default: all)")
+    a.add_argument("--shrink-lane", action="append", default=[],
+                   metavar="FIELD=HI",
+                   help="testing: pretend FIELD's packed lane tops out "
+                        "at HI — the bounds pass must then name the "
+                        "witness action that overflows it")
+    a.add_argument("--events-out", default=None,
+                   help="append per-pass 'analysis' events to this "
+                        "JSONL log (obs/)")
+    a.add_argument("--metrics-out", default=None,
+                   help="write the analysis/errors + analysis/warnings "
+                        "counter snapshot here")
+
     s = sub.add_parser("simulate", help="random-trace simulation")
     common(s)
     # Default sized for the BASELINE workload (1M traces x depth 100 ~=
@@ -154,6 +247,14 @@ def main(argv=None):
                         "(sim phase timers + step counters JSON) here")
 
     args = p.parse_args(argv)
+
+    if args.cmd == "analyze":
+        # Dispatched before the cfg-directive platform sniff below: the
+        # cfg is optional here, and analysis defaults to CPU (it only
+        # traces — touching the TPU tunnel would be pure startup cost).
+        _force_platform(args.platform or "cpu")
+        return _run_analyze(args)
+
     platform = args.platform
     if platform is None:
         # The PLATFORM backend directive must act BEFORE jax initializes,

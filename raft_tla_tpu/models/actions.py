@@ -69,9 +69,27 @@ def _set2(arr, i, k, v):
     return jnp.where(mask, v, arr)
 
 
+def build_kernels(dims: RaftDims):
+    """Per-family successor kernels: ``[(name, kernel, param_arrays)]`` in
+    ``dims.family_names`` order, each ``kernel(state, *params) ->
+    (enabled, overflow, state')`` for ONE action instance.
+
+    This is the seam the static analyzers (``analysis/``) trace through:
+    every family is exposed individually so effect extraction and interval
+    bound analysis can build one jaxpr per action instance instead of
+    dissecting the fused ``build_expand`` program.  ``build_expand``
+    assembles the grid from exactly this list, so the analyzed kernels and
+    the executed ones cannot drift apart."""
+    return _build(dims)[0]
+
+
 def build_expand(dims: RaftDims):
     """Returns ``expand(state) -> (cands, enabled, overflow)`` where
     ``cands`` stacks ``dims.n_instances`` candidate successors."""
+    return _build(dims)[1]
+
+
+def _build(dims: RaftDims):
     N, V, L, M, W = (dims.n_servers, dims.n_values, dims.max_log,
                      dims.n_msg_slots, dims.msg_width)
     i32 = jnp.int32
@@ -355,25 +373,28 @@ def build_expand(dims: RaftDims):
     ci = jnp.repeat(jnp.arange(N, dtype=i32), V)
     cv = jnp.tile(jnp.arange(1, V + 1, dtype=i32), N)
     slots = jnp.arange(M, dtype=i32)
-    extra_kernels = dims.build_extra_kernels()
+    kernels = [
+        ("Restart", restart, (servers,)),
+        ("Timeout", timeout, (servers,)),
+        ("RequestVote", request_vote, (ii, jj)),
+        ("BecomeLeader", become_leader, (servers,)),
+        ("ClientRequest", client_request, (ci, cv)),
+        ("AdvanceCommitIndex", advance_commit, (servers,)),
+        ("AppendEntries", append_entries, (ii, jj)),
+        ("Receive", receive, (slots,)),
+        ("DuplicateMessage", duplicate, (slots,)),
+        ("DropMessage", drop, (slots,)),
+    ]
+    for (params, kern), (name, _sz) in zip(dims.build_extra_kernels(),
+                                           dims.extra_families):
+        kernels.append((name, kern, tuple(params)))
 
     def expand(st: StateBatch):
         """All candidate successors of one state.  Returns
         (cands [G,...], enabled [G], overflow [G]) with G = n_instances,
         ordered per dims.family_offsets."""
-        outs = [
-            jax.vmap(restart, (None, 0))(st, servers),
-            jax.vmap(timeout, (None, 0))(st, servers),
-            jax.vmap(request_vote, (None, 0, 0))(st, ii, jj),
-            jax.vmap(become_leader, (None, 0))(st, servers),
-            jax.vmap(client_request, (None, 0, 0))(st, ci, cv),
-            jax.vmap(advance_commit, (None, 0))(st, servers),
-            jax.vmap(append_entries, (None, 0, 0))(st, ii, jj),
-            jax.vmap(receive, (None, 0))(st, slots),
-            jax.vmap(duplicate, (None, 0))(st, slots),
-            jax.vmap(drop, (None, 0))(st, slots),
-        ]
-        for params, kern in extra_kernels:
+        outs = []
+        for _name, kern, params in kernels:
             in_axes = (None,) + (0,) * len(params)
             outs.append(jax.vmap(kern, in_axes)(st, *params))
         enabled = jnp.concatenate([o[0] for o in outs])
@@ -382,4 +403,4 @@ def build_expand(dims: RaftDims):
                              *(o[2] for o in outs))
         return cands, enabled, overflow
 
-    return expand
+    return kernels, expand

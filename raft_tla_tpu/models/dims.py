@@ -37,6 +37,16 @@ unambiguous free slot.  Payload union (schemas raft.tla:443-475):
 
 ``mlog`` (the full log copy in RequestVoteResponse, raft.tla:259,465) forces
 the payload width to ``2 + 2L``.
+
+Lane widths: the static analyzer (``analysis/``) is the AUTHORITY on
+whether every packed lane is wide enough for this model.  ``python -m
+raft_tla_tpu analyze`` proves the declared domains (machine-readable in
+``analysis/lane_map.py``) fit the uint8 row per action kernel by
+interval abstract interpretation, naming the witness action otherwise;
+``schema.audit_lane_widths`` (construction) and ``build_pack_guard``
+(runtime) are the enforcement backstops, not the source of truth.  A
+variant that widens a domain should run the analyzer before trusting
+the audit's static table.
 """
 
 from __future__ import annotations

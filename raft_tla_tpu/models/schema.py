@@ -145,41 +145,30 @@ def check_packable(st: "StateBatch", dims: "RaftDims") -> None:
     lanes (log values; msg value columns) admit [0, 65535] when
     ``dims.value_bytes == 2`` (reconfiguration entries); every other
     value is unsigned [0, 255]."""
-    vb = dims.value_bytes
-    vmax = 255 if vb == 1 else 65535
+    # The analyzer's lane map (analysis/lane_map.py) decodes the failing
+    # lane for the error message: the field name plus, for message rows,
+    # the semantic column meaning, plus the action families that write
+    # the field — so the report points at the model code to look at, not
+    # just a raw lane index.  Import-light by design (no jax, no cycle).
+    from ..analysis import lane_map
+    caps = lane_map.lane_capacities(dims)
     for name, arr in zip(StateBatch._fields, st):
         a = np.asarray(arr)
         if a.size == 0:
             continue
-        if name == "msg":
-            col4 = a[..., 4]
-            vcols = () if vb == 1 else _msg_value_cols(dims)
-            skip = (4,) + tuple(vcols)
-            rest = np.delete(a, skip, axis=-1)
-            vals = a[..., list(vcols)] if vcols else np.zeros(1)
-            if ((col4 < -128).any() or (col4 > 127).any()
-                    or (rest.size and ((rest < 0).any()
-                                       or (rest > 255).any()))
-                    or (vcols and ((vals < 0).any()
-                                   or (vals > vmax).any()))):
-                raise ValueError(
-                    "state field 'msg' has value outside the packable "
-                    "range (column 4: [-128, 127]; value columns: "
-                    f"[0, {vmax}]; others: [0, 255]): observed "
-                    f"col4 [{int(col4.min())}, {int(col4.max())}], "
-                    f"others [{int(rest.min())}, {int(rest.max())}]"
-                    + (f", value cols [{int(vals.min())}, "
-                       f"{int(vals.max())}]" if vcols else ""))
-        elif name == "log_val":
-            if int(a.min()) < 0 or int(a.max()) > vmax:
-                raise ValueError(
-                    f"state field 'log_val' has value outside the "
-                    f"packable range [0, {vmax}]: min={int(a.min())}, "
-                    f"max={int(a.max())}")
-        elif int(a.min()) < 0 or int(a.max()) > 255:
+        lo_col, hi_col = caps[name]     # 'msg': per-column [W] arrays
+        bad = (a < lo_col) | (a > hi_col)
+        if bad.any():
+            idx = tuple(int(i) for i in np.argwhere(bad)[0])
+            if name == "msg":
+                lo_b, hi_b = int(lo_col[idx[-1]]), int(hi_col[idx[-1]])
+            else:
+                lo_b, hi_b = int(lo_col), int(hi_col)
             raise ValueError(
-                f"state field {name!r} has value outside the packable "
-                f"range [0, 255]: min={int(a.min())}, max={int(a.max())}")
+                f"value {int(a[idx])} at {lane_map.describe_lane(name, idx, dims)} "
+                f"is outside the packable range [{lo_b}, {hi_b}] "
+                f"(uint8 row packing would alias it silently; "
+                f"{int(bad.sum())} offending element(s) total)")
 
 
 def encode_state(s: PyState, dims: RaftDims) -> StateBatch:

@@ -1,0 +1,369 @@
+"""Static-analysis subsystem (analysis/): effects, bounds, lint, report.
+
+The effect matrix is validated two ways: against the hand-written
+per-family read/write footprint of the spec (raft.tla:136-430, the same
+derivation as ``lane_map.FIELD_WRITERS``), and differentially against
+the Python oracle — every field an oracle successor actually changes
+must lie inside the traced write set of its action family.
+"""
+
+import json
+import textwrap
+
+import numpy as np
+import pytest
+
+from raft_tla_tpu.models.dims import RaftDims
+from raft_tla_tpu.models.invariants import Bounds
+from raft_tla_tpu.models.pystate import init_state
+from raft_tla_tpu.models.schema import StateBatch, check_packable, encode_state
+from raft_tla_tpu.analysis import lane_map, run_analysis
+from raft_tla_tpu.analysis.report import ERROR, INFO, Finding, Report, WARNING
+
+DIMS = RaftDims(n_servers=3, n_values=2, max_log=3, n_msg_slots=4)
+
+
+@pytest.fixture(scope="module", autouse=True)
+def _release_tracing_caches():
+    """The analyzers trace every action kernel plus both full chunk
+    bodies; the accumulated trace/lowering caches destabilize jaxlib's
+    CPU heap enough that the big engine tests later in the suite can
+    segfault.  Dropping the caches at module teardown keeps this module
+    from taxing the rest of the run (analysis is trace-only — nothing
+    here needs a warm executable cache afterwards)."""
+    yield
+    import gc
+
+    import jax
+
+    from raft_tla_tpu.analysis import interp
+    interp.traced_kernels.cache_clear()
+    jax.clear_caches()
+    gc.collect()
+
+
+@pytest.fixture(scope="module")
+def effect_summary():
+    from raft_tla_tpu.analysis import effects
+    summary, findings = effects.analyze(DIMS)
+    return summary, findings
+
+
+# ---------------------------------------------------------------------------
+# lane map
+
+
+def test_fields_match_schema():
+    assert lane_map.FIELDS == StateBatch._fields
+
+
+def test_row_layout_covers_the_packed_row():
+    from raft_tla_tpu.models.schema import state_width
+    layout = lane_map.row_layout(DIMS)
+    assert layout[0][1] == 0
+    end = layout[-1][1] + layout[-1][2]
+    assert end == state_width(DIMS)       # base layout (value_bytes == 1)
+    f, idx = lane_map.decode_row_offset(DIMS, layout[3][1])
+    assert f == "log_term" and idx == (0, 0)
+
+
+# ---------------------------------------------------------------------------
+# effects
+
+
+def test_field_writers_table(effect_summary):
+    """The spec-derived FIELD_WRITERS table equals the traced per-family
+    write sets exactly — the cross-check that keeps the table from
+    drifting when a kernel changes."""
+    summary, _ = effect_summary
+    traced = {f: set() for f in lane_map.FIELDS}
+    for fam, d in summary.families.items():
+        for f in d["writes"]:
+            traced[f].add(fam)
+    for f in lane_map.FIELDS:
+        assert traced[f] == set(lane_map.FIELD_WRITERS[f]), f
+
+
+#: Hand-written per-family footprints from the spec's variable writes
+#: (raft.tla: Restart :136, Timeout :146, RequestVote :157, BecomeLeader
+#: :195, ClientRequest :206, AdvanceCommitIndex :219, AppendEntries :171,
+#: Receive :388 = union of all handlers, Duplicate :410, Drop :415).
+ORACLE_WRITES = {
+    "Restart": {"role", "votes_resp", "votes_gran", "next_idx",
+                "match_idx", "commit"},
+    "Timeout": {"role", "term", "voted_for", "votes_resp", "votes_gran"},
+    "RequestVote": {"msg", "msg_cnt"},
+    "BecomeLeader": {"role", "next_idx", "match_idx"},
+    "ClientRequest": {"log_term", "log_val", "log_len"},
+    "AdvanceCommitIndex": {"commit"},
+    "AppendEntries": {"msg", "msg_cnt"},
+    # Every handler's union; commit is absent because AppendEntriesAlreadyDone's
+    # :309 write is conjoined with UNCHANGED logVars (:317, the replicated
+    # upstream bug) — enabled only as a no-op.
+    "Receive": {"term", "role", "voted_for", "log_term", "log_val",
+                "log_len", "votes_resp", "votes_gran", "next_idx",
+                "match_idx", "msg", "msg_cnt"},
+    "DuplicateMessage": {"msg_cnt"},
+    "DropMessage": {"msg", "msg_cnt"},
+}
+
+ORACLE_GUARD_READS = {
+    "Restart": set(),                       # always enabled (raft.tla:136)
+    "Timeout": {"role"},                    # :147
+    "BecomeLeader": {"role", "votes_gran"},  # :196-197
+    "ClientRequest": {"role", "log_len"},   # :207 + capacity guard
+    "AdvanceCommitIndex": {"role"},         # :220
+    "DuplicateMessage": {"msg_cnt"},        # slot occupied
+    "DropMessage": {"msg_cnt"},
+}
+
+
+def test_family_write_sets_match_spec_footprints(effect_summary):
+    summary, _ = effect_summary
+    assert set(summary.families) == set(ORACLE_WRITES)
+    for fam, expect in ORACLE_WRITES.items():
+        assert summary.families[fam]["writes"] == expect, fam
+
+
+def test_family_guard_reads_match_spec_guards(effect_summary):
+    summary, _ = effect_summary
+    for fam, expect in ORACLE_GUARD_READS.items():
+        assert summary.families[fam]["guard_reads"] == expect, fam
+
+
+def test_effects_differential_against_oracle(effect_summary):
+    """Soundness against the reference interpreter: every field a real
+    oracle transition changes is inside the traced write set of its
+    family (on the canonical encoding both sides share)."""
+    from raft_tla_tpu.models import oracle
+    summary, _ = effect_summary
+    writes = {fam: d["writes"] for fam, d in summary.families.items()}
+    frontier, seen, checked = [init_state(DIMS)], set(), 0
+    for _level in range(3):
+        nxt = []
+        for s in frontier:
+            enc_s = encode_state(s, DIMS)
+            for (fam_code, _params), succ in oracle.successors(s, DIMS):
+                fam = DIMS.family_names[fam_code]
+                enc_t = encode_state(succ, DIMS)
+                changed = {f for f in lane_map.FIELDS
+                           if not np.array_equal(getattr(enc_s, f),
+                                                 getattr(enc_t, f))}
+                assert changed <= writes[fam], (fam, changed - writes[fam])
+                checked += 1
+                if succ not in seen and len(seen) < 300:
+                    seen.add(succ)
+                    nxt.append(succ)
+        frontier = nxt
+    assert checked > 100
+
+
+def test_dependence_matrix(effect_summary):
+    summary, _ = effect_summary
+    ind = summary.independent
+    G = len(summary.instances)
+    assert ind.shape == (G, G)
+    assert not ind.diagonal().any()
+    assert (ind == ind.T).all()
+    by_fam = {}
+    for k, inst in enumerate(summary.instances):
+        by_fam.setdefault(inst.family, []).append(k)
+    # Timeout writes term; Receive reads it: never independent.
+    for a in by_fam["Timeout"]:
+        for b in by_fam["Receive"]:
+            assert not ind[a, b]
+    # Timeout(i) and Timeout(j != i) touch disjoint server rows... but
+    # guard-independence is the weaker relation POR needs and holds for
+    # e.g. AdvanceCommitIndex vs DuplicateMessage.
+    for a in by_fam["AdvanceCommitIndex"]:
+        for b in by_fam["DuplicateMessage"]:
+            assert summary.guard_independent[a, b]
+            assert ind[a, b]
+
+
+def test_no_dead_lanes_on_base_model(effect_summary):
+    summary, _ = effect_summary
+    dead = {f: int(m.sum()) for f, m in summary.dead_lanes.items()}
+    assert all(v == 0 for v in dead.values()), dead
+
+
+# ---------------------------------------------------------------------------
+# bounds
+
+
+def test_bounds_proves_seed_dims_safe():
+    from raft_tla_tpu.analysis import bounds
+    summary, findings = bounds.analyze(DIMS, init_states=[init_state(DIMS)])
+    assert summary["converged"]
+    assert [f for f in findings if f.severity == ERROR] == []
+    # Unbounded pack-guarded growth (term) stays visible as a WARNING.
+    warns = {f.field for f in findings if f.severity == WARNING}
+    assert "term" in warns
+
+
+def test_bounds_catches_shrunken_term_lane():
+    from raft_tla_tpu.analysis import bounds
+    _summary, findings = bounds.analyze(
+        DIMS, init_states=[init_state(DIMS)], lane_caps={"term": (0, 15)})
+    errs = [f for f in findings
+            if f.severity == ERROR and f.code == "lane-overflow"]
+    assert errs and errs[0].field == "term"
+    assert errs[0].witness.startswith("Timeout")   # the raising action
+
+
+def test_bounds_cfg_constraints_prove_all_lanes():
+    from raft_tla_tpu.analysis import bounds
+    _summary, findings = bounds.analyze(
+        DIMS, init_states=[init_state(DIMS)],
+        bounds=Bounds(max_term=3, max_log_len=2, max_msg_count=3))
+    assert [f for f in findings if f.severity != INFO] == []
+
+
+def test_bounds_cfg_admitting_overflow_is_an_error():
+    """MaxTerm = 300 > 255: every run would hard-stop on the pack guard
+    inside the *intended* state space — ERROR, with the raiser named."""
+    from raft_tla_tpu.analysis import bounds
+    _summary, findings = bounds.analyze(
+        DIMS, init_states=[init_state(DIMS)],
+        bounds=Bounds(max_term=300, max_log_len=None, max_msg_count=None))
+    errs = {f.field: f for f in findings if f.severity == ERROR}
+    assert "term" in errs
+    assert errs["term"].witness.startswith("Timeout")
+
+
+# ---------------------------------------------------------------------------
+# lint
+
+
+def test_lint_clean_on_the_real_engine():
+    from raft_tla_tpu.analysis import lint
+    summary, findings = lint.analyze(DIMS)
+    assert [f for f in findings if f.severity == ERROR] == []
+    assert {"fingerprint", "fpset_insert", "bfs_step_v1",
+            "bfs_step_v2"} <= set(summary["kernels"])
+
+
+def test_lint_flags_planted_device_get(tmp_path):
+    from raft_tla_tpu.analysis import lint
+    fixture = tmp_path / "hot_loop.py"
+    fixture.write_text(textwrap.dedent("""\
+        import jax
+        import numpy as np
+
+        def drain(queue, mt):
+            out = []
+            while queue:
+                x = queue.pop()
+                out.append(jax.device_get(x))        # unsanctioned
+                with mt.phase_timer("fetch"):
+                    out.append(np.asarray(x))        # sanctioned sync
+                if not out:
+                    y = np.asarray(x)                # exit branch
+                    break
+            return out
+    """))
+    findings = lint.scan_host_loops(str(fixture))
+    assert len(findings) == 1
+    f = findings[0]
+    assert f.severity == ERROR and f.code == "blocking-read-in-loop"
+    assert ":8" in f.field                           # the device_get line
+
+
+def test_lint_jaxpr_flags_host_callback_and_narrowing():
+    import jax
+    import jax.numpy as jnp
+    from raft_tla_tpu.analysis import lint
+
+    def bad(x):
+        y = jax.pure_callback(
+            lambda a: a, jax.ShapeDtypeStruct((), jnp.int32), x)
+        return (y + x).astype(jnp.int8)
+
+    _summary, findings = lint.lint_jaxpr(
+        jax.make_jaxpr(bad)(jnp.int32(3)), "fixture")
+    codes = {f.code: f.severity for f in findings}
+    assert codes.get("host-callback") == ERROR
+    assert codes.get("narrowing-convert") == WARNING
+
+
+def test_lint_packing_convert_is_info_only():
+    import jax
+    import jax.numpy as jnp
+    from raft_tla_tpu.analysis import lint
+
+    _summary, findings = lint.lint_jaxpr(
+        jax.make_jaxpr(lambda x: x.astype(jnp.uint8))(jnp.int32(3)),
+        "fixture")
+    assert {f.severity for f in findings} == {INFO}
+
+
+# ---------------------------------------------------------------------------
+# report / runner / CLI
+
+
+def test_report_allowlist_downgrades_but_keeps_finding():
+    rep = Report(allowlist=["lane-overflow:term"])
+    rep.extend([Finding("bounds", ERROR, "lane-overflow", field="term",
+                        message="x", witness="Timeout(i=0)"),
+                Finding("bounds", ERROR, "lane-overflow", field="msg_cnt",
+                        message="y")])
+    assert not rep.ok                      # msg_cnt error still gates
+    js = rep.to_json()
+    f0 = js["passes"]["bounds"]["findings"][0]
+    assert f0["severity"] == WARNING and f0["allowlisted"]
+
+
+def test_run_analysis_wires_obs(tmp_path):
+    from raft_tla_tpu.obs import MetricsRegistry, RunEventLog
+    mt = MetricsRegistry()
+    ev_path = tmp_path / "events.jsonl"
+    with RunEventLog(str(ev_path)) as evlog:
+        report = run_analysis(DIMS, init_states=[init_state(DIMS)],
+                              passes=("bounds",),
+                              lane_caps={"term": (0, 15)},
+                              metrics=mt, evlog=evlog)
+    assert not report.ok
+    assert report.first_witness().startswith("Timeout")
+    assert mt.counter_value("analysis/errors") >= 1
+    events = [json.loads(line) for line in ev_path.read_text().splitlines()]
+    assert [e["pass_name"] for e in events if e["event"] == "analysis"] \
+        == ["bounds"]
+    assert events[0]["witness"].startswith("Timeout")
+
+
+def test_cli_analyze_gate(tmp_path, capsys):
+    from raft_tla_tpu.cli import main
+    out = tmp_path / "report.json"
+    rc = main(["analyze", "--max-log", "3", "--n-msg-slots", "4",
+               "--passes", "bounds", "--json", "--out", str(out)])
+    assert rc == 0
+    assert json.loads(capsys.readouterr().out)["ok"]
+    rc = main(["analyze", "--max-log", "3", "--n-msg-slots", "4",
+               "--passes", "bounds", "--shrink-lane", "term=15", "--json"])
+    assert rc == 1
+    rep = json.loads(capsys.readouterr().out)
+    errs = [f for f in rep["passes"]["bounds"]["findings"]
+            if f["severity"] == ERROR]
+    assert errs and errs[0]["witness"].startswith("Timeout")
+    # ... and the allowlist turns the same model green, visibly.
+    rc = main(["analyze", "--max-log", "3", "--n-msg-slots", "4",
+               "--passes", "bounds", "--shrink-lane", "term=15",
+               "--allow", "lane-overflow:term", "--json"])
+    assert rc == 0
+    capsys.readouterr()
+
+
+# ---------------------------------------------------------------------------
+# satellite: check_packable error decoding
+
+
+def test_check_packable_names_lane_and_writers():
+    st = encode_state(init_state(DIMS), DIMS)
+    bad = st._replace(term=np.array([0, 300, 0], np.int32))
+    with pytest.raises(ValueError, match=r"term.*Timeout, Receive"):
+        check_packable(bad, DIMS)
+    msg = np.array(st.msg)
+    msg[1, 4] = 200
+    with pytest.raises(ValueError,
+                       match=r"slot 1 column 4.*mlastLogTerm.*RequestVote"):
+        check_packable(st._replace(msg=msg), DIMS)
