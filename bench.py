@@ -118,6 +118,12 @@ def main():
     if events_file is None:
         scratch_dir = tempfile.mkdtemp(prefix="bench_obs_")
         events_file = os.path.join(scratch_dir, "events.jsonl")
+    # Per-stage chunk profiling (obs/profile.py): sampled sparsely enough
+    # (default every 64th chunk call) that the headline states/s stays a
+    # throughput number while every bench JSON still carries the stage
+    # decomposition bench_diff.py gates on.  BENCH_PROFILE_CHUNKS=0
+    # disables; engine results are bit-identical either way.
+    profile_every = int(os.environ.get("BENCH_PROFILE_CHUNKS", "64"))
     cfg = EngineConfig(
         batch=int(os.environ.get("BENCH_BATCH",
                                  str(2048 if on_accel else 512))),
@@ -126,7 +132,9 @@ def main():
         check_deadlock=False,
         record_trace=False,          # raw engine throughput (trace store is
         max_seconds=BENCH_SECONDS,   # host-side; C++ store tracked separately)
-        events_out=events_file)
+        events_out=events_file,
+        trace_out=os.environ.get("BENCH_TRACE_OUT"),
+        profile_chunks_every=profile_every or None)
     # "auto": on a multi-accelerator slice (e.g. v5e-8) the run shards
     # over all devices — the mesh engine is the product's scaling path
     # and the north-star target is defined on the full slice.
@@ -155,6 +163,17 @@ def main():
               file=sys.stderr)
         sys.exit(1)
     _mark(f"event log validated ({n_events} events)")
+    # Same contract for the span trace when one was requested: a
+    # BENCH_TRACE_OUT file Perfetto would reject fails the bench.
+    if cfg.trace_out:
+        from raft_tla_tpu.obs import validate_chrome_trace
+        try:
+            n_spans = len(validate_chrome_trace(cfg.trace_out))
+        except (OSError, ValueError) as e:
+            print(f"bench: telemetry regression — Chrome trace invalid: "
+                  f"{e}", file=sys.stderr)
+            sys.exit(1)
+        _mark(f"chrome trace validated ({n_spans} events)")
 
     # Python-oracle baseline on the same model (CPU, single core), over
     # the SAME wall budget from the same root — comparable windows, so the
@@ -200,6 +219,13 @@ def main():
         # accounting BENCH_r06+ carries so hot-path work can be targeted
         # at the phase that actually dominates.
         "phases": {k: round(v, 4) for k, v in res.phases.items()},
+        # Per-stage chunk decomposition (obs/profile.py; mean seconds per
+        # sampled batch + the fused "total" reference) and the TLC-style
+        # coverage object — the two new axes scripts/bench_diff.py gates
+        # BENCH_r* trajectories on.
+        "chunk_stages": {k: round(v, 6)
+                         for k, v in res.chunk_stages.items()},
+        "coverage": res.coverage,
         "baseline_states_per_sec": round(base_rate, 1),
         "baseline_distinct": ores.distinct_states,
         "baseline_wall_s": round(base_wall, 2),

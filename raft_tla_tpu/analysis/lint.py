@@ -195,7 +195,11 @@ def _trace_engine_kernels(dims, batch: int = 4):
             jax.ShapeDtypeStruct((), jnp.uint32),
             jax.ShapeDtypeStruct((), jnp.uint32),
             jax.ShapeDtypeStruct((), jnp.bool_),
-            jax.ShapeDtypeStruct((len(dims.family_sizes),), jnp.int32))
+            # fam_counts, fam_new (coverage), expanded — the 21-field
+            # carry (engine/chunk.py layout).
+            jax.ShapeDtypeStruct((len(dims.family_sizes),), jnp.int32),
+            jax.ShapeDtypeStruct((len(dims.family_sizes),), jnp.int32),
+            i32)
 
     qcur = jax.ShapeDtypeStruct((QA, sw), jnp.uint8)
     cnt = jax.ShapeDtypeStruct((), jnp.int32)

@@ -190,6 +190,21 @@ def main(argv=None):
                    help="write the final metrics-registry snapshot "
                         "(counters/gauges/histograms JSON) here after "
                         "the run")
+    c.add_argument("--trace-out", default=None,
+                   help="write the run's span timeline (every phase, one "
+                        "span per BFS level, the whole run) as Chrome "
+                        "trace-event JSON — opens directly in Perfetto / "
+                        "chrome://tracing (see README Observability)")
+    c.add_argument("--profile-chunks", nargs="?", const=1, type=int,
+                   default=None, metavar="N",
+                   help="sample every Nth chunk call (default 1 = every "
+                        "call) through per-stage programs with device "
+                        "fencing: expand / fingerprint / dedup-insert / "
+                        "enqueue histograms land in --metrics-out, a "
+                        "chunk_profile event in --events-out, and a "
+                        "stage-budget table on stderr at run end.  "
+                        "Observational: engine results are bit-identical "
+                        "with profiling on or off")
 
     a = sub.add_parser(
         "analyze",
@@ -245,6 +260,9 @@ def main(argv=None):
     s.add_argument("--metrics-out", default=None,
                    help="write the final metrics-registry snapshot "
                         "(sim phase timers + step counters JSON) here")
+    s.add_argument("--trace-out", default=None,
+                   help="Chrome trace-event JSON of the walker loop "
+                        "(sim_chunk/sim_fetch spans); opens in Perfetto")
 
     args = p.parse_args(argv)
 
@@ -279,7 +297,8 @@ def main(argv=None):
         from .resilience.supervisor import (run_supervised,
                                             strip_supervisor_flags)
         ckdir, events_out = args.checkpoint_dir, args.events_out
-        if ckdir is None or events_out is None:
+        trace_out = args.trace_out
+        if ckdir is None or events_out is None or trace_out is None:
             from .utils.cfg import parse_backend_directives
             try:
                 with open(args.cfg) as f:
@@ -289,6 +308,8 @@ def main(argv=None):
             ckdir = ckdir if ckdir is not None else be.get("CHECKPOINT_DIR")
             events_out = (events_out if events_out is not None
                           else be.get("EVENTS_OUT"))
+            trace_out = (trace_out if trace_out is not None
+                         else be.get("TRACE_OUT"))
         if not ckdir:
             p.error("--supervise requires --checkpoint-dir (or a "
                     "CHECKPOINT_DIR backend directive): crash-resume "
@@ -300,7 +321,8 @@ def main(argv=None):
         # supervisor owns the resume decision for restarts.
         return run_supervised(child, ckdir, max_restarts=args.supervise,
                               events_out=events_out,
-                              initial_resume=args.resume)
+                              initial_resume=args.resume,
+                              trace_out=trace_out)
 
     # Persistent compilation cache (utils/platform.py: per-host keyed):
     # repeat CLI runs of the same model skip XLA compilation — which is
@@ -372,6 +394,9 @@ def main(argv=None):
             spill_dir=resolve(args.spill_dir, "SPILL_DIR", None),
             trace_dir=resolve(args.trace_dir, "TRACE_DIR", None),
             events_out=resolve(args.events_out, "EVENTS_OUT", None),
+            trace_out=resolve(args.trace_out, "TRACE_OUT", None),
+            profile_chunks_every=resolve(args.profile_chunks,
+                                         "PROFILE_CHUNKS", None),
             degrade_on_oom=not args.no_degrade,
             progress_interval_seconds=float(
                 resolve(args.progress_interval, "PROGRESS_SECONDS", 60.0)))
@@ -445,11 +470,20 @@ def main(argv=None):
     sim = Simulator(setup.dims, invariants=resolve_invariants(setup),
                     constraint=resolve_constraint(setup),
                     batch=batch, depth=args.depth)
+    # Span tracing (obs/tracing.py): attaching the tracer to the sim's
+    # registry mirrors every sim_chunk/sim_fetch phase into the Chrome
+    # trace; one top-level span brackets the whole simulation.
+    from .obs import SpanTracer
+    tracer = SpanTracer(resolve(args.trace_out, "TRACE_OUT", None))
+    sim.metrics.tracer = tracer
     max_seconds = (args.max_seconds if args.max_seconds is not None
                    else setup.max_seconds)   # StopAfter duration budget
-    res = sim.run(initial_states(setup, seed=args.seed),
-                  num_steps=args.num_steps, seed=args.seed,
-                  max_seconds=max_seconds)
+    with tracer.span("simulate_run", num_steps=args.num_steps,
+                     batch=batch, depth=args.depth):
+        res = sim.run(initial_states(setup, seed=args.seed),
+                      num_steps=args.num_steps, seed=args.seed,
+                      max_seconds=max_seconds)
+    tracer.write()
     if args.metrics_out:
         _write_metrics(args.metrics_out, sim.metrics)
     print(f"steps visited      {res.steps}")

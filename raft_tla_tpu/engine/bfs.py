@@ -58,8 +58,10 @@ from ..models.pystate import PyState
 from ..models.schema import (ROW_DTYPE, StateBatch, build_pack_guard,
                              check_packable, decode_state, encode_state,
                              flatten_state, state_width, unflatten_state)
-from ..obs import (MetricsRegistry, RunEventLog, device_memory_stats,
-                   events_path, phase_delta)
+from ..obs import (ActionCoverage, MetricsRegistry, RunEventLog,
+                   SpanTracer, all_device_memory_stats,
+                   device_memory_stats, events_path, peak_host_rss_bytes,
+                   phase_delta)
 from ..resilience import faults as _faults
 from ..resilience.faults import is_resource_exhausted
 from ..ops import compact as compact_mod
@@ -165,6 +167,20 @@ class EngineConfig:
     # own.  Pass one to aggregate several runs (the checker service
     # does) or to read live gauges from another thread.
     metrics: Optional[object] = None
+    # Chrome trace-event span log (obs/tracing.py): every phase_timer
+    # block, a span per BFS level, and the whole run serialize to this
+    # file at run end — opens directly in Perfetto/chrome://tracing.
+    # None disables (zero overhead: the tracer no-ops).
+    trace_out: Optional[str] = None
+    # Per-stage chunk profiling (obs/profile.py): sample every Nth chunk
+    # call through separately-fenced expand/fingerprint/dedup-insert/
+    # enqueue stage programs, accumulating chunk_stage/* histograms and
+    # a run-end chunk_profile event + stage-budget table.  Observational
+    # (the real fused chunk still does all the work — results are
+    # bit-identical profiling on or off); None disables.  Single-chip
+    # engine only; the mesh ignores it (its per-chip stages interleave
+    # collectives that a staged decomposition cannot fence honestly).
+    profile_chunks_every: Optional[int] = None
     # Deadline for collecting sibling controllers' trace piece files at
     # replay (parallel/mesh.py _merge_trace_pieces).  None = auto: a 30 s
     # base plus a size-proportional allowance — the sibling of a large
@@ -200,6 +216,16 @@ class EngineResult:
     # Enabled-successor count per action family (TLC's per-action
     # statistics; family name -> count; sums to ``generated``).
     action_counts: Dict[str, int] = dataclasses.field(default_factory=dict)
+    # TLC-coverage snapshot (obs/coverage.py): {family: {generated,
+    # distinct, disabled}}.  ``generated`` here is the same series as
+    # ``action_counts`` (one packed-stats source), ``distinct`` counts
+    # first FPSet insertions per family, ``disabled`` the false guard
+    # evaluations.  Populated by the engines at run end.
+    coverage: Dict[str, Dict[str, int]] = dataclasses.field(
+        default_factory=dict)
+    # Mean seconds per sampled chunk stage ({stage: s} + "total"), when
+    # --profile-chunks ran (obs/profile.py); {} otherwise.
+    chunk_stages: Dict[str, float] = dataclasses.field(default_factory=dict)
     violation: Optional[Violation] = None
     deadlock: Optional[PyState] = None
     stop_reason: str = "exhausted"
@@ -231,19 +257,31 @@ from .trace import make_trace_store  # noqa: E402
 def _progress_line(res, t0, queue_rows, level_frontier, metrics=None):
     """TLC-style progress line (its ~per-minute report: states generated,
     distinct states, states left on queue), written to stderr by the
-    engines when progress_interval_seconds is set.  The same live
-    numbers feed the metrics registry first — the registry is the
-    supported consumer (obs/); the stderr line is a rendering of it."""
+    engines when progress_interval_seconds is set, with the TLC-parity
+    extras: distinct/s, generated/s, queue depth, and the fpset load
+    factor.  Totals render from THIS run's result object — the registry
+    can be shared across runs (the server's process-global one, warm
+    engines) and its counters are cumulative, which is exactly what a
+    per-run progress line must not print.  The per-run rates/gauges are
+    pushed to the registry first; the load factor reads the seen-set
+    gauges the engines keep current (run-scoped by construction)."""
     import sys as _sys
     dt = max(time.time() - t0, 1e-9)
+    load = 0.0
     if metrics is not None:
         metrics.gauge("engine/queue_rows", queue_rows)
         metrics.gauge("engine/level_frontier", level_frontier)
         metrics.gauge("engine/states_per_sec", res.distinct / dt)
-    print(f"progress: {res.generated:,} generated, {res.distinct:,} "
-          f"distinct ({res.distinct / dt:,.0f}/s), diameter "
-          f"{res.diameter} (expanding {level_frontier:,}), queue "
-          f"{queue_rows:,}, elapsed {dt:,.0f}s", file=_sys.stderr)
+        metrics.gauge("engine/generated_per_sec", res.generated / dt)
+        seen_cap = metrics.gauge_value("engine/seen_capacity")
+        load = (metrics.gauge_value("engine/seen_size") / seen_cap
+                if seen_cap else 0.0)
+    print(f"progress: {res.generated:,} generated "
+          f"({res.generated / dt:,.0f}/s), "
+          f"{res.distinct:,} distinct ({res.distinct / dt:,.0f}/s), "
+          f"diameter {res.diameter} (expanding {level_frontier:,}), queue "
+          f"{queue_rows:,}, fpset load {load:.2f}, elapsed {dt:,.0f}s",
+          file=_sys.stderr)
 
 
 def _exit_condition_hit(conds, res, queue_rows):
@@ -383,6 +421,31 @@ class BFSEngine:
         if not hasattr(self, "_evlog"):
             self._evlog = RunEventLog(None)
             self._phase_base = {}
+        # Span tracer (obs/tracing.py): survives re-entrant re-inits like
+        # the registry; attaching it to the registry mirrors every
+        # phase_timer block into a Chrome-trace span.
+        if not hasattr(self, "tracer"):
+            self.tracer = SpanTracer(cfg.trace_out)
+        self.metrics.tracer = self.tracer
+        # Per-stage chunk profiler (obs/profile.py; --profile-chunks).
+        # Rebuilt on re-entrant init: its stage programs are shaped by
+        # the (possibly halved) batch.
+        if cfg.profile_chunks_every:
+            from ..obs import ChunkProfiler
+            prof_k = compact_mod.choose_k(cfg.batch, dims.n_instances,
+                                          cfg.compact_lanes)
+            self._profiler = ChunkProfiler(
+                dims, batch=cfg.batch, lanes=prof_k,
+                # Same 8*K floor the engine's own table gets (below):
+                # a table smaller than one sample's K keys would saturate
+                # from the first insert and time a pathological probe.
+                seen_capacity=max(
+                    min(cfg.seen_capacity or (1 << 20), 1 << 22),
+                    8 * prof_k),
+                compact_method=cfg.compact_method,
+                every=cfg.profile_chunks_every, metrics=self.metrics)
+        else:
+            self._profiler = None
         if cfg.checkpoint_dir:
             # Fail at construction, not at the first level-boundary write.
             from . import checkpoint as _ckpt
@@ -534,12 +597,14 @@ class BFSEngine:
                     jnp.bool_(False), jnp.int32(-1),
                     jnp.zeros((sw,), jnp.uint8),
                     jnp.uint32(0), jnp.uint32(0), jnp.bool_(False),
-                    jnp.zeros((len(dims.family_sizes),), _I32))
+                    jnp.zeros((len(dims.family_sizes),), _I32),
+                    jnp.zeros((len(dims.family_sizes),), _I32),
+                    jnp.int32(0))
 
             def cond(c):
                 (offset, steps, _qn, next_count, seen_c, _tb, tcount,
                  _g, _n, ovfc, dead_any, _dr, viol_any, _vi, _vr, _vh,
-                 _vl, fail_any, _fam) = c
+                 _vl, fail_any, _fam, _famn, _exp) = c
                 more = (offset < cur_count) & (steps < max_steps)
                 qroom = next_count <= QTH       # host spills past this
                 # Stop for growth at half-full: the host doubles the table
@@ -559,13 +624,16 @@ class BFSEngine:
                 cond, lambda c: chunk_body(qcur, cur_count, c), init)
             (offset, steps, qnext, next_count, seen, tbuf, tcount,
              gen, newc, ovfc, dead_any, drow, viol_any, vinv, vrow,
-             vhi, vlo, fail_any, fam_counts) = out
-            # fam_counts rides in the SAME packed vector — the loop's
-            # one-fetch-per-call contract is load-bearing over the tunnel.
+             vhi, vlo, fail_any, fam_counts, fam_new, expanded) = out
+            # fam_counts/fam_new/expanded ride in the SAME packed vector
+            # — the loop's one-fetch-per-call contract is load-bearing
+            # over the tunnel.  Layout: 13 scalars, then the per-family
+            # generated counts, then the per-family novel counts
+            # (obs/coverage.py reads the host side).
             stats = jnp.concatenate([jnp.stack([
                 offset, steps, next_count, seen.size, tcount, gen, newc,
                 ovfc, dead_any.astype(_I32), viol_any.astype(_I32), vinv,
-                fail_any.astype(_I32)]), fam_counts])
+                fail_any.astype(_I32), expanded]), fam_counts, fam_new])
             return (qnext, seen, tbuf, stats, drow, vrow,
                     jnp.stack([vhi, vlo]))
 
@@ -673,10 +741,19 @@ class BFSEngine:
             config=dataclasses.replace(self.config, batch=new_batch))
 
     def _telemetry_run(self, impl, init_states, resume=None):
-        """Shared run_start/run_end bracketing (single-chip and mesh)."""
+        """Shared run_start/run_end bracketing (single-chip and mesh):
+        event log, run/level spans, coverage + chunk-profile run-end
+        reporting, and the Chrome-trace write-out."""
         cfg, mt = self.config, self.metrics
         self._evlog = evlog = RunEventLog(self._events_path())
         self._phase_base = mt.phase_seconds()
+        self.coverage = None        # _run_impl installs this run's own
+        prof = getattr(self, "_profiler", None)
+        if prof is not None:
+            prof.reset()            # warm engines: samples are per-run
+        if self.tracer.enabled:
+            self.tracer.reset()     # one trace file = one run
+        run_t0 = self._lvl_t0 = time.perf_counter()
         evlog.emit(
             "run_start", engine=type(self).__name__, dims=repr(self.dims),
             batch=cfg.batch, sync_every=cfg.sync_every,
@@ -695,6 +772,29 @@ class BFSEngine:
             phases = phase_delta(mt.phase_seconds(), self._phase_base)
             if res is not None:
                 res.phases = phases
+            cov = self.coverage
+            if res is not None and cov is not None:
+                res.coverage = cov.snapshot()
+                cov.feed_metrics(mt)
+                if cov.total_generated:
+                    # Final coverage snapshot: the series the progress-
+                    # interval events sampled, closed at run end.
+                    evlog.emit("coverage", final=True,
+                               level=res.diameter, actions=res.coverage)
+                if cfg.progress_interval_seconds:
+                    # TLC prints its coverage statistics at the end of a
+                    # run with reporting enabled; same cadence knob here.
+                    import sys as _sys
+                    print(cov.render_table(), file=_sys.stderr)
+            # Re-read the profiler: OOM degradation re-enters __init__,
+            # which rebuilds it for the halved batch — the run-end
+            # report must come from the object that took the most
+            # recent samples, not the pre-degrade one captured above.
+            prof = getattr(self, "_profiler", None)
+            if prof is not None:
+                if res is not None:
+                    res.chunk_stages = prof.stage_means()
+                prof.finish(evlog)
             evlog.emit(
                 "run_end",
                 stop_reason=(getattr(res, "stop_reason", None)
@@ -709,9 +809,19 @@ class BFSEngine:
                 levels=list(getattr(res, "levels", None) or []),
                 wall_seconds=getattr(res, "wall_seconds", None),
                 growth_stalls=len(getattr(res, "growth_stalls", ())),
-                phase_seconds=phases, memory=device_memory_stats())
+                phase_seconds=phases, memory=device_memory_stats(),
+                # Peak host RSS + one probe per visible device; CPU-only
+                # platforms report {} per device rather than omitting
+                # the field (obs/events.py guards).
+                host_rss_peak_bytes=peak_host_rss_bytes(),
+                devices_memory=all_device_memory_stats())
             evlog.close()
             self._evlog = RunEventLog(None)
+            if self.tracer.enabled:
+                self.tracer.complete(
+                    "run", run_t0, engine=type(self).__name__,
+                    stop_reason=getattr(res, "stop_reason", None))
+                self.tracer.write()
 
     def _events_path(self):
         """Single-controller resolution; the mesh engine overrides with
@@ -724,7 +834,17 @@ class BFSEngine:
         breakdown.  ``unattributed_seconds`` closes the accounting —
         phases + unattributed == elapsed since run_start — so a phase
         that silently stops being timed shows up as growing slack, not a
-        plausible-looking breakdown."""
+        plausible-looking breakdown.  Also closes this level's span in
+        the Chrome trace (one ``level`` span per BFS level)."""
+        if self.tracer.enabled:
+            self.tracer.complete("level", self._lvl_t0, level=res.diameter,
+                                 frontier_rows=frontier_rows,
+                                 distinct=res.distinct,
+                                 generated=res.generated)
+            # Level-boundary durability: a crash loses at most the
+            # current level's spans (atomic rewrite, off the hot loop).
+            self.tracer.write()
+        self._lvl_t0 = time.perf_counter()
         evlog = self._evlog
         if not evlog.enabled:
             return
@@ -756,6 +876,11 @@ class BFSEngine:
         self._cur_res = res     # run_end event reads it on error exits
         mt, evlog = self.metrics, self._evlog
         self._growth_stalls = res.growth_stalls
+        # TLC-style per-action coverage for this run (obs/coverage.py):
+        # fed from the packed chunk stats, reported at every progress
+        # interval and at run end (_telemetry_run).
+        coverage = self.coverage = ActionCoverage(dims.family_names,
+                                                  dims.family_sizes)
         t_enter = time.time()   # for early returns before the budget clock
         # Trace recording off => plain dict store (never written); avoids
         # triggering the native build for runs that measure raw throughput.
@@ -916,6 +1041,14 @@ class BFSEngine:
             res.diameter = resume.diameter
             res.levels = list(resume.levels)
             res.action_counts = dict(resume.action_counts)
+            # Coverage resumes its generated series from the checkpoint
+            # so the run-end table still matches generated_by_action
+            # (distinct/expanded are not checkpointed; see
+            # coverage.disabled).  The registry counters are NOT seeded:
+            # they are process-cumulative, and an in-process degrade
+            # resume already accumulated the pre-crash increments — the
+            # progress line renders per-run totals from res instead.
+            coverage.seed_generated(resume.action_counts)
             # Duration (TLCGet("duration")-style) accumulates across
             # restarts: back-date t0 so wall_seconds, states/sec, and the
             # max_seconds budget all measure total checking time.
@@ -998,6 +1131,10 @@ class BFSEngine:
             pending, spill_next = spill_next, pending
             next_count = jnp.int32(0)
 
+        # Seen-set gauges for the registry-rendered progress line (load
+        # factor = seen_size / seen_capacity); kept current per chunk.
+        mt.gauge("engine/seen_capacity", len(seen.hi))
+        mt.gauge("engine/seen_size", int(seen.size))
         # A resumed run must not rewrite the snapshot it just loaded (a
         # trace-off resume would overwrite a trace-carrying file with an
         # empty trace), and its interval clock starts at the restart.
@@ -1067,6 +1204,16 @@ class BFSEngine:
                             # by a whole sync_every chunk.
                             allowed = 1
                     calls_in_level += 1
+                    prof = self._profiler
+                    if prof is not None and prof.want():
+                        # Observational per-stage sample of the batch
+                        # this call will expand first (obs/profile.py):
+                        # the real fused chunk below still does all the
+                        # work — results stay bit-identical.
+                        with mt.phase_timer("profile"):
+                            prof.sample(
+                                qcur[offset:offset + B],
+                                (offset + np.arange(B)) < cur_count)
                     if _faults.ACTIVE:
                         # Deterministic injection sites (resilience/):
                         # "kill" dies here (mid-level, past the level's
@@ -1113,12 +1260,20 @@ class BFSEngine:
                     mt.counter("engine/distinct", n_new)
                     mt.counter("engine/generated", n_gen)
                     mt.gauge("engine/seen_size", seen_size)
+                    mt.gauge("engine/seen_capacity", len(seen.hi))
                     mt.gauge("engine/next_count", next_count_h)
                     mt.gauge("engine/diameter", res.diameter)
+                    F = len(dims.family_sizes)
                     if n_gen:
-                        for name, c in zip(dims.family_names, st[12:]):
+                        for name, c in zip(dims.family_names,
+                                           st[13:13 + F]):
                             res.action_counts[name] = (
                                 res.action_counts.get(name, 0) + int(c))
+                    # TLC-style coverage (obs/coverage.py): same packed
+                    # stats, attributed per family — generated/distinct/
+                    # disabled all derive from this one fetch.
+                    coverage.add_chunk(int(st[12]), st[13:13 + F],
+                                       st[13 + F:13 + 2 * F])
                     if cfg.record_trace and tcount:
                         with mt.phase_timer("trace_flush"):
                             self._flush_trace(trace, tbuf, tcount)
@@ -1191,6 +1346,12 @@ class BFSEngine:
                         if want_progress:
                             _progress_line(res, t0, queue_rows, cur_count,
                                            metrics=mt)
+                            # Coverage rides the same cadence (TLC's
+                            # -coverage interval): registry gauges plus
+                            # one structured event per interval.
+                            coverage.feed_metrics(mt)
+                            evlog.emit("coverage", level=res.diameter,
+                                       actions=coverage.snapshot())
                             last_progress = time.time()
                         # Checked last: a violation or deadlock in the same
                         # chunk outranks a budget stop (TLC reports the

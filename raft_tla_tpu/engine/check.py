@@ -76,7 +76,9 @@ def engine_config_from_backend(setup: CheckSetup) -> EngineConfig:
         keep_checkpoints=be.get("KEEP_CHECKPOINTS"),
         spill_dir=be.get("SPILL_DIR"),
         trace_dir=be.get("TRACE_DIR"),
-        events_out=be.get("EVENTS_OUT"))
+        events_out=be.get("EVENTS_OUT"),
+        trace_out=be.get("TRACE_OUT"),
+        profile_chunks_every=be.get("PROFILE_CHUNKS"))
 
 
 def make_engine(setup: CheckSetup,

@@ -17,14 +17,20 @@ bit-identical per batch (same candidate order, same compaction, same
 trace layout) for checkpoints to be portable across engines and for the
 differential tests to mean anything.
 
-The carry tuple layout (19 fields) is:
+The carry tuple layout (21 fields) is:
     (offset, steps, qnext, next_count, seen, tbuf, tcount,
      gen, newc, ovfc, dead_any, drow, viol_any, vinv, vrow, vhi, vlo,
-     fail_any, fam_counts)
+     fail_any, fam_counts, fam_new, expanded)
 
 ``fam_counts`` [n_families] accumulates enabled-successor counts per
 action family (TLC's per-action statistics; SURVEY §5.1) — a handful of
-static-slice reduces per batch.
+static-slice reduces per batch.  ``fam_new`` [n_families] accumulates
+per-family NOVEL-state counts (the insert's novelty mask attributed to
+the compacted lane's action family — TLC coverage's "distinct"), and
+``expanded`` counts parents actually advanced past (valid, inside the
+taken prefix) — the exact base for host-side disabled-guard counts
+(``expanded * family_size - generated``).  All ride the same packed
+stats vector; obs/coverage.py is the host-side consumer.
 """
 
 from __future__ import annotations
@@ -65,7 +71,7 @@ def build_chunk_body(*, dims, expand, fingerprint, pack_ok, inv_fns,
     def chunk_body(qcur, cur_count, carry):
         (offset, steps, qnext, next_count, seen, tbuf, tcount,
          gen, newc, ovfc, dead_any, drow, viol_any, vinv, vrow,
-         vhi, vlo, fail_any, fam_counts) = carry
+         vhi, vlo, fail_any, fam_counts, fam_new, expanded) = carry
         rows = jax.lax.dynamic_slice_in_dim(qcur, offset, B, axis=0)
         valid = (offset + jnp.arange(B, dtype=_I32)) < cur_count
         states = jax.vmap(unflatten_state, (0, None))(rows, dims)
@@ -206,12 +212,19 @@ def build_chunk_body(*, dims, expand, fingerprint, pack_ok, inv_fns,
         fam_counts = fam_counts + jnp.stack(
             [jnp.sum(en[:, off:off + sz], dtype=_I32)
              for off, sz in fam_slices])
+        # Per-family novelty (coverage "distinct"): attribute each novel
+        # compacted lane to the family of the action that produced it.
+        kact = lane_id % G
+        fam_new = fam_new + jnp.stack(
+            [jnp.sum(new & (kact >= off) & (kact < off + sz), dtype=_I32)
+             for off, sz in fam_slices])
+        expanded = expanded + jnp.sum(valid & ptaken, dtype=_I32)
         return (offset + P, steps + 1, qnext, next_count, seen, tbuf,
                 tcount, gen + total,
                 newc + jnp.sum(new, dtype=_I32),
                 ovfc + jnp.sum(ovf, dtype=_I32),
                 dead_any | dead_any_b, drow,
                 viol_any | viol_any_b, vinv, vrow, vhi, vlo,
-                fail_any | fail, fam_counts)
+                fail_any | fail, fam_counts, fam_new, expanded)
 
     return chunk_body

@@ -1,6 +1,6 @@
 """Telemetry subsystem shared by every engine and entry point.
 
-Two halves, one spine:
+One spine, several legs:
 
 - :mod:`.metrics` — a zero-dep, thread-safe :class:`MetricsRegistry`
   (counters / gauges / histograms) with a :meth:`~MetricsRegistry.phase_timer`
@@ -8,17 +8,33 @@ Two halves, one spine:
   and the simulate/mesh paths;
 - :mod:`.events` — the structured JSONL :class:`RunEventLog`
   (run_start, level_complete, fpset_resize, spill, checkpoint,
-  violation, deadlock, run_end) written next to the checkpoint dir and
-  per-host under ``parallel/mesh.py``.
+  violation, deadlock, chunk_profile, coverage, run_end) written next
+  to the checkpoint dir and per-host under ``parallel/mesh.py``;
+- :mod:`.tracing` — :class:`SpanTracer`, nested spans serialized as
+  Chrome trace-event JSON (``--trace-out``; opens in Perfetto).
+  Attached to a registry it mirrors every phase_timer block;
+- :mod:`.profile` — :class:`ChunkProfiler`, the per-stage chunk
+  decomposition behind ``--profile-chunks`` (expand / fingerprint /
+  dedup-insert / enqueue histograms + the run-end stage-budget table);
+- :mod:`.coverage` — :class:`ActionCoverage`, TLC-style per-action
+  generated/distinct/disabled counters and the run-end coverage table.
 
-The CLI exposes them via ``--metrics-out`` / ``--events-out``, the
-checker service via the ``stats`` request, and ``bench.py`` embeds the
-final phase breakdown in its JSON.  See README.md "Observability" for
-the event schema and metric-name inventory.
+The CLI exposes them via ``--metrics-out`` / ``--events-out`` /
+``--trace-out`` / ``--profile-chunks``, the checker service via the
+``stats`` request, and ``bench.py`` embeds the phase breakdown, chunk
+stage means, and coverage in its JSON (``scripts/bench_diff.py`` gates
+on all three).  See README.md "Observability" for the schemas.
 """
 
 from .metrics import (Histogram, MetricsRegistry, PHASE_PREFIX,  # noqa: F401
                       phase_delta)
-from .events import (REQUIRED_EVENTS, RunEventLog,               # noqa: F401
-                     device_memory_stats, events_path,
+from .events import (KNOWN_EVENTS, REQUIRED_EVENTS, RunEventLog,  # noqa: F401
+                     all_device_memory_stats, device_memory_stats,
+                     events_path, peak_host_rss_bytes,
                      validate_and_cleanup, validate_run_events)
+from .tracing import SpanTracer, validate_chrome_trace           # noqa: F401
+from .coverage import ActionCoverage                             # noqa: F401
+# .profile imports jax lazily but pulls model/ops modules at call time;
+# import the class here for the one-stop namespace (still jax-free at
+# import).
+from .profile import ChunkProfiler                               # noqa: F401

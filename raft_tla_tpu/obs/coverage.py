@@ -1,0 +1,120 @@
+"""TLC-style action coverage — per-action generated/distinct/disabled.
+
+TLC's ``-coverage`` report is the thing users actually read when a check
+stalls: per action, how many successor states it generated and how many
+of those were distinct, over time.  The engines already compute per-lane
+enablement and novelty masks on device; this module gives those masks
+the TLC rendering: per-family **generated** (enabled successor
+evaluations — TLC's "states found"), **distinct** (novel states whose
+first FPSet insertion came through this action's lane), and **disabled**
+(guard evaluations that came up false — ``expanded_parents x
+family_size - generated``, computed host-side from the same packed
+stats, zero extra device work).
+
+Counter provenance: ``generated`` per family is the exact series the
+engines have always accumulated into ``EngineResult.action_counts``
+(``generated_by_action`` in bench JSON), read from the SAME packed stats
+vector — the run-end table therefore matches bench JSON bit-exactly by
+construction.  ``distinct`` is a second per-family reduction of the
+insert's novelty mask (engine/chunk.py), summing to the run's
+expansion-phase distinct count (roots are not action coverage).
+
+Zero-dep host-side accumulator (no jax), like the rest of ``obs/``.
+Consumers: a ``coverage`` run event each progress interval and at run
+end, ``coverage/<family>/generated|distinct`` registry counters (the
+server's ``stats`` op), the run-end stderr table, and the ``coverage``
+object in bench JSON that ``scripts/bench_diff.py`` gates on.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Sequence
+
+
+class ActionCoverage:
+    """Per-action-family coverage accumulator (one per engine run)."""
+
+    def __init__(self, family_names: Sequence[str],
+                 family_sizes: Sequence[int]):
+        self.names: List[str] = list(family_names)
+        self.sizes: List[int] = [int(s) for s in family_sizes]
+        self.generated: Dict[str, int] = {n: 0 for n in self.names}
+        self.distinct: Dict[str, int] = {n: 0 for n in self.names}
+        #: Parents actually expanded (each evaluates every instance's
+        #: guard once) — the base for the disabled counts.
+        self.expanded = 0
+
+    def add_chunk(self, expanded: int, gen_counts, new_counts) -> None:
+        """Fold one chunk call's packed per-family stats in.
+        ``gen_counts``/``new_counts`` are the per-family vectors from the
+        chunk stats (any int sequence), ``expanded`` the parents the
+        call advanced past."""
+        self.expanded += int(expanded)
+        for name, g, d in zip(self.names, gen_counts, new_counts):
+            g, d = int(g), int(d)
+            if g:
+                self.generated[name] += g
+            if d:
+                self.distinct[name] += d
+
+    def seed_generated(self, action_counts: Dict[str, int]) -> None:
+        """Resume support: continue the generated series from a
+        checkpoint's ``action_counts`` so the run-end table still
+        matches ``generated_by_action`` exactly.  Distinct/expanded are
+        not checkpointed and restart from zero — a resumed run's
+        distinct column covers the post-resume portion only."""
+        for name, c in action_counts.items():
+            if name in self.generated:
+                self.generated[name] += int(c)
+
+    def disabled(self, name: str) -> int:
+        size = self.sizes[self.names.index(name)]
+        # Clamped: a resumed run's expanded counter restarts at zero
+        # while generated resumes from the checkpoint, which would
+        # otherwise push this negative.
+        return max(0, self.expanded * size - self.generated[name])
+
+    @property
+    def total_generated(self) -> int:
+        return sum(self.generated.values())
+
+    @property
+    def total_distinct(self) -> int:
+        return sum(self.distinct.values())
+
+    def snapshot(self) -> Dict[str, Dict[str, int]]:
+        """JSON-ready ``{family: {generated, distinct, disabled}}`` — the
+        payload of ``coverage`` events and bench JSON's ``coverage``."""
+        return {n: {"generated": self.generated[n],
+                    "distinct": self.distinct[n],
+                    "disabled": self.disabled(n)}
+                for n in self.names}
+
+    def feed_metrics(self, metrics) -> None:
+        """Mirror the totals into registry gauges (idempotent — gauges,
+        not counters, so a progress-interval refresh never double-counts)
+        for the server's ``stats`` op and ``--metrics-out`` snapshots."""
+        for n in self.names:
+            metrics.gauge(f"coverage/{n}/generated", self.generated[n])
+            metrics.gauge(f"coverage/{n}/distinct", self.distinct[n])
+            metrics.gauge(f"coverage/{n}/disabled", self.disabled(n))
+        metrics.gauge("coverage/expanded_states", self.expanded)
+
+    def render_table(self) -> str:
+        """The TLC-parity run-end report (stderr): one row per action
+        family, sorted by generated, with the distinct ratio that tells
+        a user which actions are churning duplicates."""
+        rows = sorted(self.names, key=lambda n: -self.generated[n])
+        width = max([len(n) for n in self.names] + [6])
+        lines = [f"coverage (actions: {len(self.names)}, parents "
+                 f"expanded: {self.expanded:,}):",
+                 f"  {'action':{width}s} {'generated':>12s} "
+                 f"{'distinct':>12s} {'disabled':>14s} {'new%':>6s}"]
+        for n in rows:
+            g, d = self.generated[n], self.distinct[n]
+            pct = f"{100.0 * d / g:5.1f}%" if g else "    --"
+            lines.append(f"  {n:{width}s} {g:12,d} {d:12,d} "
+                         f"{self.disabled(n):14,d} {pct:>6s}")
+        lines.append(f"  {'total':{width}s} {self.total_generated:12,d} "
+                     f"{self.total_distinct:12,d}")
+        return "\n".join(lines)

@@ -30,6 +30,37 @@ from typing import Optional
 #: Event types a complete, healthy run always contains.
 REQUIRED_EVENTS = ("run_start", "run_end")
 
+#: Every event type the engines/tooling emit (documentation + the
+#: validator's schema table).  Unknown types still validate — forward
+#: compatibility — but known STRUCTURED types must carry their payload
+#: field, so a half-written profiler/coverage emitter fails the bench
+#: gate instead of shipping empty records.
+KNOWN_EVENTS = (
+    "run_start", "level_complete", "fpset_resize", "spill", "checkpoint",
+    "violation", "deadlock", "run_end", "restart", "supervised_done",
+    "supervise_giveup", "degraded", "analysis",
+    # Deep-profiling layer (obs/profile.py, obs/coverage.py):
+    "chunk_profile",    # per-stage chunk timings; payload: "stages"
+    "coverage",         # TLC-style per-action counters; payload: "actions"
+)
+
+#: Structured payload field each new event type must carry.
+_EVENT_PAYLOAD_FIELDS = {"chunk_profile": "stages", "coverage": "actions"}
+
+
+#: memory_stats() keys kept in event payloads (one extraction for the
+#: single-device and per-device probes, so they can never desynchronize).
+_MEMORY_KEEP = ("bytes_in_use", "peak_bytes_in_use", "bytes_limit",
+                "largest_alloc_size")
+
+
+def _probe_device(device) -> dict:
+    try:
+        stats = device.memory_stats() or {}
+    except Exception:
+        return {}
+    return {k: int(stats[k]) for k in _MEMORY_KEEP if k in stats}
+
 
 def device_memory_stats() -> dict:
     """Compact view of the first device's ``memory_stats()`` probe (the
@@ -37,12 +68,37 @@ def device_memory_stats() -> dict:
     backend reports nothing (virtual CPU devices) or jax is unavailable."""
     try:
         import jax
-        stats = jax.devices()[0].memory_stats() or {}
+        return _probe_device(jax.devices()[0])
     except Exception:
         return {}
-    keep = ("bytes_in_use", "peak_bytes_in_use", "bytes_limit",
-            "largest_alloc_size")
-    return {k: int(stats[k]) for k in keep if k in stats}
+
+
+def all_device_memory_stats() -> list:
+    """Per-device memory probes for the run_end event, one dict per
+    visible device IN ORDER.  Guarded the same way as the single-device
+    probe: a platform whose devices report nothing (CPU, virtual
+    devices) contributes ``{}`` per device — the field is always
+    present, never silently absent — and a jax-less process returns
+    ``[]``."""
+    try:
+        import jax
+        devices = jax.devices()
+    except Exception:
+        return []
+    return [_probe_device(d) for d in devices]
+
+
+def peak_host_rss_bytes():
+    """Peak resident set size of this process in bytes (ru_maxrss is KB
+    on Linux, bytes on macOS — normalize to bytes), or None where the
+    resource module is unavailable (non-POSIX)."""
+    try:
+        import resource
+        import sys
+        peak = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
+        return int(peak) if sys.platform == "darwin" else int(peak) * 1024
+    except Exception:
+        return None
 
 
 def events_path(events_out: Optional[str], checkpoint_dir: Optional[str],
@@ -148,6 +204,12 @@ def validate_run_events(path: str,
                 raise ValueError(
                     f"{path}:{ln}: event record missing 'event'/'ts': "
                     f"{line[:120]}")
+            payload = _EVENT_PAYLOAD_FIELDS.get(rec["event"])
+            if payload is not None and not isinstance(
+                    rec.get(payload), dict):
+                raise ValueError(
+                    f"{path}:{ln}: {rec['event']!r} event missing its "
+                    f"{payload!r} payload object: {line[:120]}")
             events.append(rec)
     have = {e["event"] for e in events}
     missing = [r for r in required if r not in have]

@@ -87,6 +87,11 @@ class MetricsRegistry:
         self._counters: Dict[str, float] = {}
         self._gauges: Dict[str, float] = {}
         self._histograms: Dict[str, Histogram] = {}
+        # Optional span tracer (obs/tracing.py SpanTracer, duck-typed —
+        # this module stays import-free): when attached and enabled,
+        # every phase_timer block is mirrored as a Chrome-trace span, so
+        # one attachment instruments every existing phase site.
+        self.tracer = None
 
     # -- writers -------------------------------------------------------
     def counter(self, name: str, inc: float = 1) -> None:
@@ -116,11 +121,18 @@ class MetricsRegistry:
             yield
         finally:
             self.observe(PHASE_PREFIX + name, time.perf_counter() - t0)
+            tracer = self.tracer
+            if tracer is not None and tracer.enabled:
+                tracer.complete(name, t0)
 
     # -- readers -------------------------------------------------------
     def counter_value(self, name: str) -> float:
         with self._lock:
             return self._counters.get(name, 0)
+
+    def gauge_value(self, name: str, default: float = 0.0) -> float:
+        with self._lock:
+            return self._gauges.get(name, default)
 
     def phase_seconds(self) -> Dict[str, float]:
         """{phase name: accumulated seconds} — the per-phase breakdown

@@ -1,0 +1,317 @@
+"""Per-stage chunk profiler — the instrument behind ``--profile-chunks``.
+
+NORTHSTAR.md's decision rule needs per-stage timings of the chunk
+pipeline (expand / fingerprint / dedup-insert / enqueue) on whatever
+hardware a run actually lands on, and until now the only way to get them
+was the ad-hoc ``scripts/profile_step.py`` path on a synthetic frontier.
+This module puts that decomposition behind one API and INSIDE the
+engine: every Nth chunk call, the profiler re-runs the sampled batch
+through separately-jitted stage programs with ``block_until_ready``
+fencing between stages, accumulates per-stage histograms into the
+MetricsRegistry (``chunk_stage/<stage>``), and emits one
+``chunk_profile`` run event plus a stderr stage-budget table keyed to
+NORTHSTAR's measured per-stage budget at run end.
+
+The profiler is **observational**: the engine's real fused chunk program
+still does all the work, and the sampled batch is re-expanded on the
+side purely for measurement — so engine results are bit-identical with
+profiling on or off (the acceptance contract), at the cost of roughly
+``1/N`` extra compute.  The staged decomposition measures the v1
+(classical) pipeline regardless of which pipeline the engine runs: the
+stages are the NORTHSTAR budget's row headings, and cross-pipeline
+comparability of the headings matters more than mirroring v2's fused
+deltas.  The separately-timed ``total`` program (all four stages in one
+jit, non-donating) is the fusion reference: ``sum(stages)`` vs
+``total`` prices the inter-stage materialization XLA elides.
+
+Stage -> pipeline mapping (engine/chunk.py):
+
+    expand        unflatten + vmap(expand) over B*G lanes + compaction
+    fingerprint   gather K candidate structs + two-lane hash
+    dedup_insert  ops/fpset.py batched insert (in-batch dedup + probe)
+    enqueue       materialize K uint8 rows + position scatter
+
+jax is imported lazily (constructor), keeping ``obs`` importable in
+device-less tooling like the rest of the package.
+"""
+
+from __future__ import annotations
+
+import sys
+import time
+from typing import Dict, Optional
+
+STAGES = ("expand", "fingerprint", "dedup_insert", "enqueue")
+
+STAGE_PREFIX = "chunk_stage/"
+
+#: NORTHSTAR.md §c measured v1 budget (ms/batch, B=2048, TPU v5e chip),
+#: folded onto this profiler's stage granularity: expand includes the
+#: compact stage (36.6 + 21.4), enqueue includes row materialization
+#: (24.6 + 14.5).  Reference column of the run-end table — compare
+#: shapes, not absolutes, off that hardware/batch.
+NORTHSTAR_BUDGET_MS = {
+    "expand": 58.0,
+    "fingerprint": 6.7,
+    "dedup_insert": 5.3,
+    "enqueue": 39.1,
+}
+
+
+def build_stage_programs(dims, B: int, K: int,
+                         compact_method: str = "scatter") -> dict:
+    """The jitted stage programs, shared by :class:`ChunkProfiler` and
+    ``scripts/profile_step.py`` (which used to hand-roll the same
+    decomposition).  Returns ``{stage_name: fn, "total": fn,
+    "queue_rows": int, "empty_seen": fn}``; see module docstring for the
+    stage -> pipeline mapping."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..models.actions import build_expand
+    from ..models.schema import flatten_state, unflatten_state
+    from ..ops import fpset
+    from ..ops.compact import build_compactor
+    from ..ops.fingerprint import build_fingerprint
+
+    _I32 = jnp.int32
+    G = dims.n_instances
+    BG = B * G
+    expand = build_expand(dims)
+    fingerprint = build_fingerprint(dims)
+    compactor = build_compactor(B, G, K, method=compact_method)
+    # Profiler-local next-queue: K live rows + K per-lane trash slots
+    # (the engine's trash-spread rule, ops/fpset.py design note 3).  The
+    # scatter's cost scales with the rows written (K), not the target
+    # size, so the small target keeps profiler memory bounded.
+    QP = K
+
+    def s_expand(rows, valid):
+        states = jax.vmap(unflatten_state, (0, None))(rows, dims)
+        cands, en, _ovf = jax.vmap(expand)(states)
+        en = en & valid[:, None]
+        _P, _total, lane_id, kvalid = compactor(en)
+        cflat = jax.tree.map(
+            lambda a: a.reshape((BG,) + a.shape[2:]), cands)
+        return cflat, lane_id, kvalid
+
+    def s_fingerprint(cflat, lane_id):
+        kstates = jax.tree.map(lambda a: a[lane_id], cflat)
+        kh, kl = jax.vmap(fingerprint)(kstates)
+        return kstates, kh, kl
+
+    def s_insert(seen, kh, kl, kvalid):
+        return fpset.insert(seen, kh, kl, kvalid)
+
+    def s_enqueue(qnext, kstates, enq):
+        krows = jax.vmap(flatten_state, (0, None))(kstates, dims)
+        pos = jnp.cumsum(enq.astype(_I32)) - 1
+        pos = jnp.where(enq, pos, QP + jnp.arange(K, dtype=_I32))
+        return qnext.at[pos].set(krows, mode="drop")
+
+    def s_total(rows, valid, seen, qnext):
+        cflat, lane_id, kvalid = s_expand(rows, valid)
+        kstates, kh, kl = s_fingerprint(cflat, lane_id)
+        seen, new, _fail = s_insert(seen, kh, kl, kvalid)
+        qnext = s_enqueue(qnext, kstates, new)
+        return seen, qnext, jnp.sum(new, dtype=_I32)
+
+    return {
+        "expand": jax.jit(s_expand),
+        "fingerprint": jax.jit(s_fingerprint),
+        "dedup_insert": jax.jit(s_insert),
+        "enqueue": jax.jit(s_enqueue),
+        "total": jax.jit(s_total),
+        "queue_rows": 2 * QP,
+        "empty_seen": lambda cap: fpset.empty(cap),
+    }
+
+
+class ChunkProfiler:
+    """Samples every ``every``-th chunk call of one engine run.
+
+    Owns two persistent FPSet tables (staged and fused paths receive
+    every sample's keys, so both see the same load trajectory) and a
+    small scatter target; everything else is rebuilt per sample from the
+    engine's own frontier rows."""
+
+    def __init__(self, dims, *, batch: int, lanes: int,
+                 seen_capacity: int, compact_method: str = "scatter",
+                 every: int = 1, metrics=None):
+        self.dims = dims
+        self.B, self.K = int(batch), int(lanes)
+        self.seen_capacity = int(seen_capacity)
+        self.compact_method = compact_method
+        self.every = max(1, int(every))
+        self.metrics = metrics
+        self.samples = 0
+        self._calls = 0
+        self._built = None
+        self._stage_totals: Dict[str, float] = {s: 0.0 for s in STAGES}
+        self._total_total = 0.0
+
+    def reset(self) -> None:
+        """Zero the accumulators for a new run (warm/reused engines);
+        compiled stage programs and the persistent tables are kept."""
+        self.samples = 0
+        self._calls = 0
+        self._stage_totals = {s: 0.0 for s in STAGES}
+        self._total_total = 0.0
+
+    # -- sampling ------------------------------------------------------
+    def want(self) -> bool:
+        """Advance the chunk-call counter; True when this call should be
+        sampled (first call always is, so short runs still profile)."""
+        self._calls += 1
+        return (self._calls - 1) % self.every == 0
+
+    def _build(self, rows, valid):
+        import jax
+        import jax.numpy as jnp
+        progs = build_stage_programs(self.dims, self.B, self.K,
+                                     self.compact_method)
+        from ..models.schema import state_width
+        sw = state_width(self.dims)
+        self._qnext = jnp.zeros((progs["queue_rows"], sw), jnp.uint8)
+        self._seen_staged = progs["empty_seen"](self.seen_capacity)
+        self._seen_total = progs["empty_seen"](self.seen_capacity)
+        # One untimed pass compiles every program, so compile time never
+        # lands in the first sample's histogram bucket.
+        cflat, lane_id, kvalid = progs["expand"](rows, valid)
+        kstates, kh, kl = progs["fingerprint"](cflat, lane_id)
+        self._seen_staged, new, _f = progs["dedup_insert"](
+            self._seen_staged, kh, kl, kvalid)
+        self._qnext = progs["enqueue"](self._qnext, kstates, new)
+        self._seen_total, self._qnext, n = progs["total"](
+            rows, valid, self._seen_total, self._qnext)
+        jax.block_until_ready((self._seen_staged, self._qnext, n))
+        self._built = progs
+        return progs
+
+    def sample(self, rows, valid) -> None:
+        """Profile one batch: ``rows`` [B, sw] device/host rows, ``valid``
+        [B] bool parent-validity mask.  Fenced with block_until_ready
+        before and between stages so each interval is one stage's device
+        time (plus one dispatch — the fused ``total`` row prices that
+        overhead)."""
+        import jax
+        import jax.numpy as jnp
+        rows = jnp.asarray(rows)
+        valid = jnp.asarray(valid)
+        progs = self._built or self._build(rows, valid)
+        mt = self.metrics
+        timings = {}
+
+        def fence(stage, out):
+            jax.block_until_ready(out)
+            t = time.perf_counter()
+            dt = t - fence.t0
+            fence.t0 = t
+            timings[stage] = dt
+            return out
+
+        fence.t0 = time.perf_counter()
+        cflat, lane_id, kvalid = fence(
+            "expand", progs["expand"](rows, valid))
+        kstates, kh, kl = fence(
+            "fingerprint", progs["fingerprint"](cflat, lane_id))
+        self._seen_staged, new, fail = fence("dedup_insert", progs[
+            "dedup_insert"](self._seen_staged, kh, kl, kvalid))
+        if mt is not None and bool(fail):
+            # The profiler's private table saturated: dedup_insert
+            # timings from here on measure a pathologically full probe,
+            # not the engine's.  Surfaced as a counter, never fatal.
+            mt.counter("chunk_stage/insert_fail")
+        self._qnext = fence(
+            "enqueue", progs["enqueue"](self._qnext, kstates, new))
+        self._seen_total, self._qnext, _n = fence("total", progs[
+            "total"](rows, valid, self._seen_total, self._qnext))
+
+        self.samples += 1
+        for s in STAGES:
+            self._stage_totals[s] += timings[s]
+            if mt is not None:
+                mt.observe(STAGE_PREFIX + s, timings[s])
+        self._total_total += timings["total"]
+        if mt is not None:
+            mt.observe(STAGE_PREFIX + "total", timings["total"])
+
+    # -- reporting -----------------------------------------------------
+    def stage_means(self) -> Dict[str, float]:
+        """{stage: mean seconds/sampled batch} (+ ``total`` for the fused
+        reference) — what bench JSON embeds as ``chunk_stages``."""
+        if not self.samples:
+            return {}
+        out = {s: self._stage_totals[s] / self.samples for s in STAGES}
+        out["total"] = self._total_total / self.samples
+        return out
+
+    def summary(self) -> dict:
+        means = self.stage_means()
+        staged_sum = sum(means.get(s, 0.0) for s in STAGES)
+        return {
+            "samples": self.samples,
+            "every": self.every,
+            "batch": self.B,
+            "lanes": self.K,
+            "stages": {s: {"mean_seconds": round(means[s], 6),
+                           "total_seconds":
+                               round(self._stage_totals[s], 6),
+                           "budget_ms_b2048": NORTHSTAR_BUDGET_MS[s]}
+                       for s in STAGES} if self.samples else {},
+            "fused_total_mean_seconds": round(means.get("total", 0.0), 6),
+            "staged_sum_mean_seconds": round(staged_sum, 6),
+        }
+
+    def render_table(self) -> str:
+        """Run-end stage-budget table: measured mean ms per stage next to
+        NORTHSTAR §c's measured v1 budget (B=2048, v5e) — the shape
+        comparison that names which stage to fuse next."""
+        means = self.stage_means()
+        if not means:
+            return "chunk profile: no samples"
+        lines = [f"chunk profile ({self.samples} sampled batches, "
+                 f"B={self.B}, K={self.K}, every {self.every}th call):",
+                 f"  {'stage':14s} {'mean ms':>10s} {'share':>7s} "
+                 f"{'NORTHSTAR ms@B=2048':>20s}"]
+        staged_sum = sum(means[s] for s in STAGES)
+        for s in STAGES:
+            ms = means[s] * 1e3
+            share = means[s] / staged_sum if staged_sum else 0.0
+            lines.append(f"  {s:14s} {ms:10.2f} {share:6.1%} "
+                         f"{NORTHSTAR_BUDGET_MS[s]:20.1f}")
+        lines.append(f"  {'sum(stages)':14s} {staged_sum * 1e3:10.2f}")
+        lines.append(f"  {'fused total':14s} {means['total'] * 1e3:10.2f}"
+                     f"  (inter-stage materialization the fused program "
+                     f"elides)")
+        return "\n".join(lines)
+
+    def finish(self, evlog, stream=None) -> None:
+        """Run-end hook: emit the ``chunk_profile`` event and print the
+        stage-budget table.  No-op when nothing was sampled."""
+        if not self.samples:
+            return
+        evlog.emit("chunk_profile", **self.summary())
+        print(self.render_table(), file=stream or sys.stderr)
+
+
+def profile_stages(dims, rows, valid=None, *, lanes: Optional[int] = None,
+                   seen_capacity: int = 1 << 20, n: int = 3,
+                   compact_method: str = "scatter") -> Dict[str, float]:
+    """One-shot stage profile of a frontier batch — the
+    ``scripts/profile_step.py`` entry point, now on the shared programs.
+    Returns {stage: mean seconds} over ``n`` fenced repetitions (first
+    repetition untimed: compile)."""
+    import numpy as np
+
+    from ..ops.compact import choose_k
+    B = int(rows.shape[0])
+    if valid is None:
+        valid = np.ones((B,), bool)
+    prof = ChunkProfiler(
+        dims, batch=B,
+        lanes=lanes or choose_k(B, dims.n_instances, None),
+        seen_capacity=seen_capacity, compact_method=compact_method)
+    for _ in range(n):
+        prof.sample(rows, valid)
+    return prof.stage_means()

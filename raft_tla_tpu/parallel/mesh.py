@@ -94,6 +94,27 @@ class MeshBFSEngine:
         if not hasattr(self, "_evlog"):
             self._evlog = RunEventLog(None)
             self._phase_base = {}
+        # Span tracer (obs/tracing.py): survives the re-entrant re-init
+        # like the registry; attached to the registry it mirrors every
+        # phase_timer block into a Chrome-trace span.  Multi-host runs
+        # get one trace per controller (piece suffix, like event logs).
+        if not hasattr(self, "tracer"):
+            from ..obs import SpanTracer
+            trace_out = cfg.trace_out
+            if trace_out is not None:
+                try:
+                    pi, pc = jax.process_index(), jax.process_count()
+                except Exception:
+                    pi, pc = 0, 1
+                if pc > 1:
+                    root, ext = os.path.splitext(trace_out)
+                    trace_out = f"{root}.p{pi}of{pc}{ext or '.json'}"
+            self.tracer = SpanTracer(trace_out)
+        self.metrics.tracer = self.tracer
+        # The per-stage chunk profiler is a single-chip instrument
+        # (EngineConfig.profile_chunks_every rationale); the mesh's
+        # observability is spans + phases + coverage.
+        self._profiler = None
         if cfg.checkpoint_dir:
             # Fail at construction, not at the first level-boundary write.
             from ..engine import checkpoint as _ckpt
@@ -265,12 +286,14 @@ class MeshBFSEngine:
                     jnp.bool_(False), jnp.int32(-1),
                     jnp.zeros((sw,), jnp.uint8),
                     jnp.uint32(0), jnp.uint32(0), jnp.bool_(False),
-                    jnp.zeros((len(dims.family_sizes),), _I32))
+                    jnp.zeros((len(dims.family_sizes),), _I32),
+                    jnp.zeros((len(dims.family_sizes),), _I32),
+                    jnp.int32(0))
 
             def cond(c):
                 (offset, steps, _qn, ncnt_c, seen_c, _tb, tcnt_c,
                  _g, _n, ovfc, dead_any, _dr, viol_any, _vi, _vr, _vh,
-                 _vl, fail_any, _fam) = c
+                 _vl, fail_any, _fam, _famn, _exp) = c
                 # Every term is reduced to a REPLICATED bool so all chips
                 # take the same trip count (the body contains all_to_all).
                 more = (offset < max_count) & (steps < max_steps)
@@ -288,7 +311,7 @@ class MeshBFSEngine:
                 cond, lambda c: chunk_body(qcur_l, cnt_l, c), init)
             (offset, steps, qnext_l, ncnt_l, seen_l, tbuf_l, tcnt_l,
              gen, newc, ovfc, dead_any, drow, viol_any, vinv, vrow,
-             vhi, vlo, fail_any, fam_counts) = out
+             vhi, vlo, fail_any, fam_counts, fam_new, expanded) = out
             g_gen = jax.lax.psum(gen, "x")
             g_new = jax.lax.psum(newc, "x")
             g_ovf = jax.lax.psum(ovfc, "x")
@@ -315,8 +338,10 @@ class MeshBFSEngine:
                            v_any.astype(_I32),
                            d_any.astype(_I32),
                            vinv_g,
-                           jax.lax.psum(cnt_l, "x")]),
-                jax.lax.psum(fam_counts, "x")])
+                           jax.lax.psum(cnt_l, "x"),
+                           jax.lax.psum(expanded, "x")]),
+                jax.lax.psum(fam_counts, "x"),
+                jax.lax.psum(fam_new, "x")])
             vfp_g = jnp.stack([vhi_g, vlo_g])
             return (qnext_l[None], ncnt_l[None], seen_l.hi[None],
                     seen_l.lo[None], seen_l.size[None],
@@ -569,6 +594,12 @@ class MeshBFSEngine:
         self._cur_res = res     # run_end event reads it on error exits
         mt, evlog = self.metrics, self._evlog
         self._growth_stalls = res.growth_stalls
+        # TLC-style per-action coverage (obs/coverage.py); stats are
+        # psum-replicated, so every controller accumulates identical
+        # global counts.
+        from ..obs import ActionCoverage
+        coverage = self.coverage = ActionCoverage(dims.family_names,
+                                                  dims.family_sizes)
         t_enter = time.time()
         trace = make_trace_store() if cfg.record_trace else TraceStore()
         self.trace = trace
@@ -724,6 +755,9 @@ class MeshBFSEngine:
             res.diameter = resume.diameter
             res.levels = list(resume.levels)
             res.action_counts = dict(resume.action_counts)
+            # Coverage-only resume seeding (engine/bfs.py rule: registry
+            # counters are process-cumulative and must not be re-seeded).
+            coverage.seed_generated(resume.action_counts)
             t0 -= resume.wall_seconds
             if cfg.record_trace:
                 if resume.distinct > 0 and resume.trace_fps.size == 0:
@@ -923,12 +957,19 @@ class MeshBFSEngine:
                     mt.counter("engine/generated", int(st[2]))
                     mt.counter("engine/distinct", int(st[3]))
                     mt.gauge("engine/seen_size", int(st[10]))
+                    mt.gauge("engine/seen_capacity", self._CL)
                     mt.gauge("engine/next_count", cur_sum)
                     mt.gauge("engine/diameter", res.diameter)
+                    F = len(dims.family_sizes)
                     if int(st[2]):
-                        for name, c in zip(dims.family_names, st[15:]):
+                        for name, c in zip(dims.family_names,
+                                           st[16:16 + F]):
                             res.action_counts[name] = (
                                 res.action_counts.get(name, 0) + int(c))
+                    # Coverage from the same psum'd packed stats
+                    # (obs/coverage.py; engine/bfs.py rationale).
+                    coverage.add_chunk(int(st[15]), st[16:16 + F],
+                                       st[16 + F:16 + 2 * F])
                     if int(st[4]):
                         raise RuntimeError(
                             f"{int(st[4])} successors exceeded fixed-width "
@@ -1010,6 +1051,11 @@ class MeshBFSEngine:
                         if want_progress:
                             _progress_line(res, t0, queue_rows,
                                            int(st[14]), metrics=mt)
+                            # Coverage on the same cadence (engine/
+                            # bfs.py): registry gauges + one event.
+                            coverage.feed_metrics(mt)
+                            evlog.emit("coverage", level=res.diameter,
+                                       actions=coverage.snapshot())
                             last_progress = time.time()
                         # Last: a violation/deadlock in the same chunk
                         # outranks a budget stop (engine/bfs.py rationale).

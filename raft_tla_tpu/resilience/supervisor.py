@@ -48,7 +48,8 @@ def run_supervised(child_argv: List[str], checkpoint_dir: str,
                    backoff_factor: float = 2.0,
                    backoff_cap_seconds: float = 60.0,
                    initial_resume: Optional[str] = None,
-                   env: Optional[dict] = None) -> int:
+                   env: Optional[dict] = None,
+                   trace_out: Optional[str] = None) -> int:
     """Run ``child_argv`` under crash-resume supervision; returns the
     final child exit code.  ``child_argv`` is the complete child command
     (e.g. ``[sys.executable, "-m", "raft_tla_tpu", "check", ...]``)
@@ -62,14 +63,23 @@ def run_supervised(child_argv: List[str], checkpoint_dir: str,
     ``latest()`` differs from what the dir held before the first
     attempt — a child that crashed before its first snapshot must
     restart from scratch, not from a previous run's stale image (whose
-    cfg may not even match; load() validates only dims)."""
+    cfg may not even match; load() validates only dims).
+
+    ``trace_out`` (the run's ``--trace-out`` value, when set): the CHILD
+    keeps writing its engine trace to that path — the last completed
+    attempt's trace wins, which is the one a user wants to open — while
+    the supervisor records its own timeline (one ``attempt`` span per
+    child run, ``restart`` instants with exit codes) to
+    ``<trace_out>.supervisor.json``."""
     # Deferred: engine.checkpoint imports resilience.faults for its
     # injection sites, and this module rides in resilience/__init__ —
     # top-level imports here would close that cycle during package init.
     from ..engine import checkpoint as ckpt_mod
-    from ..obs import RunEventLog, events_path
+    from ..obs import RunEventLog, SpanTracer, events_path
     evpath = events_path(events_out, checkpoint_dir)
     evlog = RunEventLog(evpath)
+    tracer = SpanTracer(f"{trace_out}.supervisor.json" if trace_out
+                        else None, process_name="supervisor")
     preexisting = ckpt_mod.latest(checkpoint_dir)
     attempt = 0
     try:
@@ -82,7 +92,10 @@ def run_supervised(child_argv: List[str], checkpoint_dir: str,
                     ckpt_mod.latest(checkpoint_dir) != preexisting:
                 argv += ["--resume", "auto"]
             ends_before = _count_run_ends(evpath)
+            attempt_t0 = time.perf_counter()
             rc = subprocess.call(argv, env=env)
+            tracer.complete("attempt", attempt_t0, attempt=attempt,
+                            exit_code=rc)
             if rc == 0 or (rc == 1
                            and _completed_counterexample(evpath,
                                                          ends_before)):
@@ -112,6 +125,8 @@ def run_supervised(child_argv: List[str], checkpoint_dir: str,
                 nxt = None       # stale-dir guard: see docstring
             evlog.emit("restart", attempt=attempt, exit_code=rc,
                        resume_from=nxt, backoff_seconds=round(delay, 3))
+            tracer.instant("restart", attempt=attempt, exit_code=rc,
+                           resume_from=nxt)
             print(f"supervisor: child exited {rc}; restart {attempt}/"
                   f"{max_restarts} in {delay:.1f}s "
                   + (f"resuming {nxt}" if nxt else "from scratch"),
@@ -119,6 +134,8 @@ def run_supervised(child_argv: List[str], checkpoint_dir: str,
             time.sleep(delay)
     finally:
         evlog.close()
+        if tracer.enabled:
+            tracer.write()
 
 
 def _run_end_reasons(evpath: Optional[str]) -> Optional[Dict[str, List[str]]]:

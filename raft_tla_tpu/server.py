@@ -155,6 +155,10 @@ def _do_check(req):
     base = engine_config_from_backend(setup)
     cfg = dataclasses.replace(
         base,
+        # Engines share the process-global registry, so engine counters,
+        # phase timers, and coverage gauges aggregate across requests
+        # and surface in the "stats" op (the obs/ aggregation pattern).
+        metrics=_METRICS,
         batch=(int(req["batch"]) if req.get("batch") is not None
                else base.batch),
         queue_capacity=(req["queue_capacity"]
@@ -206,6 +210,10 @@ def _do_check(req):
            # Host-side per-phase wall-time breakdown for THIS run
            # (obs/ phase timers) — same shape bench.py embeds.
            "phases": {k: round(v, 4) for k, v in res.phases.items()},
+           # TLC-style per-action coverage (obs/coverage.py), same
+           # object bench JSON carries; also mirrored as coverage/*
+           # gauges in the "stats" op.
+           "coverage": dict(res.coverage),
            "violation": None, "deadlock": None}
     if res.violation is not None:
         out["violation"] = _violation_json(engine, res.violation,

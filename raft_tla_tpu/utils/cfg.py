@@ -30,7 +30,9 @@ comments, a backend-annotated cfg still parses and runs under stock TLC
 unchanged — the cfg stays the single source of truth for both engines.
 Recognized keys: BATCH, QUEUE_CAPACITY, SEEN_CAPACITY, N_MSG_SLOTS,
 MAX_LOG, PLATFORM, CHECKPOINT_DIR, CHECKPOINT_EVERY, CHECKPOINT_INTERVAL,
-SPILL_DIR, TRACE_DIR, PROGRESS_SECONDS, EVENTS_OUT, KEEP_CHECKPOINTS.
+SPILL_DIR, TRACE_DIR, PROGRESS_SECONDS, EVENTS_OUT, KEEP_CHECKPOINTS,
+TRACE_OUT (Chrome-trace span file), PROFILE_CHUNKS (per-stage chunk
+profiling cadence).
 Precedence everywhere: CLI flag > cfg backend key > built-in default.
 """
 
@@ -79,7 +81,7 @@ _BACKEND_KEYS = {
     "BATCH", "QUEUE_CAPACITY", "SEEN_CAPACITY", "N_MSG_SLOTS", "MAX_LOG",
     "PLATFORM", "CHECKPOINT_DIR", "CHECKPOINT_EVERY", "CHECKPOINT_INTERVAL",
     "SPILL_DIR", "TRACE_DIR", "PROGRESS_SECONDS", "EVENTS_OUT",
-    "KEEP_CHECKPOINTS",
+    "KEEP_CHECKPOINTS", "TRACE_OUT", "PROFILE_CHUNKS",
 }
 
 

@@ -1,0 +1,230 @@
+"""Diff two bench JSONs and gate on regressions — the enforceable form
+of the BENCH_r* trajectory.
+
+``bench.py`` (and the round-note harness that wraps it into
+``BENCH_rNN.json``) emits one JSON object per run: headline
+states/s, per-phase host seconds, per-stage chunk means
+(``chunk_stages``, obs/profile.py), and the TLC-style ``coverage``
+object (obs/coverage.py).  This script compares OLD vs NEW along all
+four axes and exits nonzero when NEW regresses past the thresholds —
+so CI (and a human mid-perf-PR) gets a yes/no instead of two JSON
+blobs to eyeball.
+
+    python scripts/bench_diff.py BENCH_r04.json BENCH_r05.json
+    python scripts/bench_diff.py old.json new.json --max-regress 0.05
+
+Input forms accepted: the raw bench.py object, or the ``BENCH_rNN``
+wrapper ``{"cmd", "rc", "tail", "parsed": {...}}`` (the parsed object
+is used; a null ``parsed`` — a bench run that never emitted JSON — is
+malformed input, exit 2).
+
+Comparison rules (each axis only when BOTH runs carry it — early
+BENCH_r01–r05 files predate chunk_stages/coverage and still diff):
+
+- headline ``value`` (distinct states/s) and ``generated_per_sec``:
+  regression when NEW < OLD * (1 - max_regress).
+- per-phase seconds: normalized to seconds per million distinct states
+  (budget-length independence), compared per phase when the OLD phase
+  is at least ``--phase-floor`` of total phase time (noise floor for
+  sub-percent phases); threshold ``--phase-max-regress``.
+- per-stage chunk means (``chunk_stages``): direct per-stage ratio,
+  threshold ``--stage-max-regress``; the fused ``total`` row is
+  compared too (it is the engine-shaped number).
+- coverage mix: per-action share of total generated; an action whose
+  share moves more than ``--coverage-drift`` (absolute percentage
+  points) is flagged.  This is a semantics drift detector, not a perf
+  number — identical models must produce identical mixes up to
+  duration-budget truncation — so it defaults loose (5 pts).
+
+Improvements are reported but never fail.  Exit codes: 0 pass, 1 at
+least one regression, 2 malformed input/usage (consistent with the
+validate_run_events convention: a gate that cannot read its evidence
+fails loudly, not silently green).
+"""
+
+import argparse
+import json
+import sys
+
+PHASE_PREFIX_SKIP = ("profile",)   # measurement overhead, not engine work
+
+
+def load_bench(path: str) -> dict:
+    """Load a bench JSON in either accepted form; raise ValueError on
+    anything that is not a bench result object."""
+    try:
+        with open(path, encoding="utf-8") as f:
+            data = json.load(f)
+    except (OSError, json.JSONDecodeError) as e:
+        raise ValueError(f"{path}: cannot load bench JSON: {e}")
+    if isinstance(data, dict) and "parsed" in data:
+        data = data["parsed"]           # BENCH_rNN wrapper
+    if not isinstance(data, dict) or "value" not in data:
+        raise ValueError(
+            f"{path}: not a bench result (no 'value' field; a BENCH_r* "
+            f"wrapper whose run emitted no JSON has parsed=null)")
+    return data
+
+
+def _ratio_regress(old: float, new: float, thresh: float) -> bool:
+    """True when NEW is worse than OLD by more than ``thresh`` (rates:
+    lower is worse — callers flip sign for costs)."""
+    return old > 0 and new < old * (1.0 - thresh)
+
+
+class Diff:
+    """Accumulates findings; renders the report and the exit code."""
+
+    def __init__(self):
+        self.regressions = []
+        self.notes = []
+
+    def regress(self, msg: str) -> None:
+        self.regressions.append(msg)
+
+    def note(self, msg: str) -> None:
+        self.notes.append(msg)
+
+    def render(self, stream=sys.stdout) -> int:
+        for n in self.notes:
+            print(f"  {n}", file=stream)
+        for r in self.regressions:
+            print(f"  REGRESSION: {r}", file=stream)
+        verdict = ("FAIL" if self.regressions else "PASS")
+        print(f"bench_diff: {verdict} "
+              f"({len(self.regressions)} regression(s))", file=stream)
+        return 1 if self.regressions else 0
+
+
+def diff_headline(old: dict, new: dict, d: Diff, max_regress: float):
+    # The headline's direction follows its unit: rates (".../s",
+    # bench.py) regress downward, costs ("ms/iter", true_bench.py TB_JSON)
+    # regress upward.
+    unit = old.get("unit", "states/s")
+    higher_is_better = not unit.startswith("ms")
+    for key, label in (("value", f"headline ({unit})"),
+                       ("generated_per_sec", "generated states/s")):
+        ov, nv = old.get(key), new.get(key)
+        if ov is None or nv is None:
+            continue
+        pct = (nv - ov) / ov * 100.0 if ov else 0.0
+        d.note(f"{label}: {ov:,.1f} -> {nv:,.1f} ({pct:+.1f}%)")
+        worse = (_ratio_regress(ov, nv, max_regress) if higher_is_better
+                 else ov > 0 and nv > ov * (1.0 + max_regress))
+        if worse:
+            d.regress(f"{label} moved {pct:+.1f}% "
+                      f"(> {max_regress:.0%} allowed): {ov:,.1f} -> "
+                      f"{nv:,.1f}")
+
+
+def diff_phases(old: dict, new: dict, d: Diff, max_regress: float,
+                floor: float):
+    op, np_ = old.get("phases") or {}, new.get("phases") or {}
+    od, nd = old.get("distinct_states"), new.get("distinct_states")
+    if not op or not np_ or not od or not nd:
+        return
+    ototal = sum(op.values()) or 1.0
+    for phase in sorted(set(op) & set(np_)):
+        if phase in PHASE_PREFIX_SKIP:
+            continue
+        if op[phase] / ototal < floor:
+            continue        # sub-floor phases are timer noise
+        # Seconds per 1M distinct states: compares runs of different
+        # duration budgets on the same model.
+        oc = op[phase] / od * 1e6
+        nc = np_[phase] / nd * 1e6
+        pct = (nc - oc) / oc * 100.0 if oc else 0.0
+        d.note(f"phase {phase}: {oc:.2f} -> {nc:.2f} s/M-distinct "
+               f"({pct:+.1f}%)")
+        if oc > 0 and nc > oc * (1.0 + max_regress):
+            d.regress(f"phase '{phase}' cost rose {pct:.1f}% "
+                      f"(> {max_regress:.0%} allowed): {oc:.2f} -> "
+                      f"{nc:.2f} s/M-distinct")
+
+
+def diff_stages(old: dict, new: dict, d: Diff, max_regress: float):
+    os_, ns = old.get("chunk_stages") or {}, new.get("chunk_stages") or {}
+    if not os_ or not ns:
+        return
+    for stage in sorted(set(os_) & set(ns)):
+        oc, nc = os_[stage], ns[stage]
+        pct = (nc - oc) / oc * 100.0 if oc else 0.0
+        d.note(f"chunk stage {stage}: {oc * 1e3:.2f} -> {nc * 1e3:.2f} "
+               f"ms/batch ({pct:+.1f}%)")
+        if oc > 0 and nc > oc * (1.0 + max_regress):
+            d.regress(f"chunk stage '{stage}' rose {pct:.1f}% "
+                      f"(> {max_regress:.0%} allowed): {oc * 1e3:.2f} -> "
+                      f"{nc * 1e3:.2f} ms/batch")
+
+
+def diff_coverage(old: dict, new: dict, d: Diff, drift_pts: float):
+    # generated_by_action predates the coverage object and carries the
+    # same generated series — accept either so old BENCH files diff.
+    ocov = old.get("coverage") or {}
+    ncov = new.get("coverage") or {}
+    og = ({a: v["generated"] for a, v in ocov.items()} if ocov
+          else old.get("generated_by_action") or {})
+    ng = ({a: v["generated"] for a, v in ncov.items()} if ncov
+          else new.get("generated_by_action") or {})
+    if not og or not ng:
+        return
+    ot, nt = sum(og.values()), sum(ng.values())
+    if not ot or not nt:
+        return
+    for action in sorted(set(og) | set(ng)):
+        oshare = og.get(action, 0) / ot * 100.0
+        nshare = ng.get(action, 0) / nt * 100.0
+        delta = nshare - oshare
+        if abs(delta) >= drift_pts:
+            d.regress(f"coverage mix drift: '{action}' share moved "
+                      f"{delta:+.1f} pts ({oshare:.1f}% -> {nshare:.1f}%"
+                      f", > {drift_pts:g} pts allowed) — same-model "
+                      f"runs should agree; different model/bounds means "
+                      f"the two benches are not comparable")
+        elif delta:
+            d.note(f"coverage {action}: {oshare:.1f}% -> {nshare:.1f}% "
+                   f"of generated")
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        description="diff two bench JSONs; nonzero exit on regression")
+    p.add_argument("old", help="baseline bench JSON (raw or BENCH_r* "
+                               "wrapper)")
+    p.add_argument("new", help="candidate bench JSON")
+    p.add_argument("--max-regress", type=float, default=0.10,
+                   help="allowed fractional drop in headline rates "
+                        "(default 0.10 = 10%%)")
+    p.add_argument("--phase-max-regress", type=float, default=0.35,
+                   help="allowed fractional rise in per-phase "
+                        "s/M-distinct (noisier than the headline; "
+                        "default 0.35)")
+    p.add_argument("--stage-max-regress", type=float, default=0.35,
+                   help="allowed fractional rise in per-stage chunk "
+                        "means (default 0.35)")
+    p.add_argument("--phase-floor", type=float, default=0.02,
+                   help="ignore phases below this fraction of total "
+                        "phase time in the baseline (default 0.02)")
+    p.add_argument("--coverage-drift", type=float, default=5.0,
+                   help="allowed absolute drift (percentage points) in "
+                        "any action's share of generated states "
+                        "(default 5.0)")
+    args = p.parse_args(argv)
+
+    try:
+        old, new = load_bench(args.old), load_bench(args.new)
+    except ValueError as e:
+        print(f"bench_diff: {e}", file=sys.stderr)
+        return 2
+
+    print(f"bench_diff: {args.old} -> {args.new}")
+    d = Diff()
+    diff_headline(old, new, d, args.max_regress)
+    diff_phases(old, new, d, args.phase_max_regress, args.phase_floor)
+    diff_stages(old, new, d, args.stage_max_regress)
+    diff_coverage(old, new, d, args.coverage_drift)
+    return d.render()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -69,9 +69,18 @@ def main(argv=None):
     ap.add_argument("--batch", type=int, default=128)
     ap.add_argument("--restarts", type=int, default=5)
     ap.add_argument("--keep-workdir", action="store_true")
+    ap.add_argument("--workdir", default=None,
+                    help="run in this directory (implies --keep-workdir; "
+                         "CI points it somewhere uploadable so the event "
+                         "logs + Chrome traces become artifacts)")
     args = ap.parse_args(argv)
 
-    work = tempfile.mkdtemp(prefix="chaos_")
+    if args.workdir:
+        work = os.path.abspath(args.workdir)
+        os.makedirs(work, exist_ok=True)
+        args.keep_workdir = True
+    else:
+        work = tempfile.mkdtemp(prefix="chaos_")
     base = [sys.executable, "-m", "raft_tla_tpu", "check",
             os.path.join(REPO, args.cfg), "--platform", "cpu",
             "--batch", str(args.batch),
@@ -85,12 +94,14 @@ def main(argv=None):
     ok = True
     try:
         clean_log = os.path.join(work, "clean.jsonl")
+        clean_trace = os.path.join(work, "clean_trace.json")
         print(f"chaos: baseline run ({args.cfg}, "
               f"max_diameter={args.max_diameter})", flush=True)
         # cwd=REPO so `python -m raft_tla_tpu` resolves regardless of
         # where the harness itself was invoked from (no installed pkg).
-        rc = subprocess.call(base + ["--events-out", clean_log], env=env,
-                             cwd=REPO)
+        rc = subprocess.call(base + ["--events-out", clean_log,
+                                     "--trace-out", clean_trace],
+                             env=env, cwd=REPO)
         if rc not in (0, 1):
             print(f"FAIL: baseline run exited {rc}")
             return 1
@@ -101,9 +112,11 @@ def main(argv=None):
                        FAULT_STATE_DIR=os.path.join(work, "fault_state"))
         print(f"chaos: supervised run under faults {args.faults!r}",
               flush=True)
+        sup_trace = os.path.join(work, "sup_trace.json")
         rc_sup = subprocess.call(
             base + ["--checkpoint-dir", sup_dir,
                     "--checkpoint-interval", "0",
+                    "--trace-out", sup_trace,
                     "--supervise", str(args.restarts)],
             env=sup_env, cwd=REPO)
         if rc_sup != rc:
@@ -143,6 +156,22 @@ def main(argv=None):
                 ok = False
             else:
                 print(f"chaos: {degraded} degraded event(s)")
+
+        # Trace-format gate: both runs' --trace-out files must be valid
+        # Chrome trace JSON arrays (obs.validate_chrome_trace) — the
+        # supervised engine trace is the LAST attempt's (each child
+        # rewrites it), and the supervisor adds its own attempt/restart
+        # timeline next to it.
+        sys.path.insert(0, REPO)
+        from raft_tla_tpu.obs import validate_chrome_trace
+        for tpath in (clean_trace, sup_trace,
+                      sup_trace + ".supervisor.json"):
+            try:
+                n = len(validate_chrome_trace(tpath))
+                print(f"chaos: trace ok ({n} events): {tpath}")
+            except (OSError, ValueError) as e:
+                print(f"FAIL: invalid Chrome trace: {e}")
+                ok = False
         print("chaos: OK" if ok else "chaos: FAILED")
         return 0 if ok else 1
     finally:

@@ -3,17 +3,19 @@ ambient platform (TPU under the driver; CPU anywhere).  This is the
 instrument for the round-3 performance work: run it before and after any
 engine change and commit the numbers.
 
-Parts timed (all jitted separately, block_until_ready between), matching
-the compacted chunk pipeline in engine/bfs.py:
+The staged decomposition (expand / fingerprint / dedup_insert /
+enqueue, fenced between stages) comes from the shared
+``obs.profile`` API — the same programs ``--profile-chunks`` samples
+inside a live engine run — so this script's numbers and an engine
+run's ``chunk_profile`` event are the same instrument.  On top of
+that, this script times what the in-engine profiler can't:
 
-  expand          rows -> candidate StateBatch [B,G] + enabled
-  fingerprint     expand + fingerprints for all B*G lanes
-  compact         expand + fp + prefix-sum compaction to K lanes
-  insert          fpset.insert on K compacted keys (sort + probe rounds)
-  materialize     gather K candidate states + flatten to uint8 rows
-  enqueue         scatter K rows into the next queue (trash-spread lanes)
-  CHUNK           the engine's real fused chunk program, 1 batch/call
-  CHUNK x8        ditto, 8 batches per call (sync_every amortization)
+  compact[searchsorted]  the alternate compaction lowering
+  fpset_pallas.insert    Mosaic sequential-probe insert (TPU only)
+  enqueue pallas         run-coalesced DMA append (TPU only)
+  CHUNK                  the engine's real fused chunk program
+  CHUNK x8               ditto, 8 batches per call (sync_every)
+  CHUNK v2 / v2+ss+win   the delta pipeline + full candidate config
 
 Run:  python scripts/profile_step.py [batch]
 
@@ -42,10 +44,7 @@ from raft_tla_tpu.engine.check import initial_states, make_engine
 from raft_tla_tpu.models.actions import build_expand
 from raft_tla_tpu.models.schema import flatten_state, unflatten_state
 from raft_tla_tpu.ops import fpset
-from raft_tla_tpu.ops.fingerprint import build_fingerprint
 from raft_tla_tpu.utils.cfg import load_config
-
-_I32 = jnp.int32
 
 
 def bench(label, fn, *args, n=10, **kw):
@@ -93,26 +92,24 @@ def main():
     reps = -(-QA // len(wrows))
     qcur = jnp.asarray(np.tile(wrows, (reps, 1))[:QA])
 
+    # The staged decomposition — the SAME programs --profile-chunks runs
+    # inside a live engine (obs/profile.py), so a number printed here
+    # and a chunk_profile event disagree only if the hardware does.
+    from raft_tla_tpu.obs.profile import (STAGES, build_stage_programs,
+                                          profile_stages)
+    rows = qcur[:B]
+    means = profile_stages(dims, np.asarray(rows), lanes=K,
+                           seen_capacity=cfg.seen_capacity, n=10)
+    for s in STAGES:
+        print(f"{s + ' (staged, fenced)':42s} {means[s] * 1e3:9.2f} ms")
+    staged_sum = sum(means[s] for s in STAGES)
+    print(f"{'sum(stages)':42s} {staged_sum * 1e3:9.2f} ms")
+    print(f"{'staged total (one jit, non-donating)':42s} "
+          f"{means['total'] * 1e3:9.2f} ms")
+
+    # Beyond the shared stages: the alternate compaction lowering...
     expand = build_expand(dims)
-    fingerprint = build_fingerprint(dims)
     from raft_tla_tpu.ops.compact import build_compactor
-    compactor = build_compactor(B, G, K)
-
-    @jax.jit
-    def part_expand(rows):
-        states = jax.vmap(unflatten_state, (0, None))(rows, dims)
-        cands, en, ovf = jax.vmap(expand)(states)
-        return jax.tree.map(lambda a: a.sum(), cands), en.sum()
-
-    @jax.jit
-    def part_compact(rows):
-        states = jax.vmap(unflatten_state, (0, None))(rows, dims)
-        cands, en, ovf = jax.vmap(expand)(states)
-        cflat = jax.tree.map(
-            lambda a: a.reshape((BG,) + a.shape[2:]), cands)
-        _P, _total, lane_id, kvalid = compactor(en)
-        return (cflat, lane_id, kvalid)
-
     compactor_ss = build_compactor(B, G, K, method="searchsorted")
 
     @jax.jit
@@ -124,44 +121,15 @@ def main():
         _P, _total, lane_id, kvalid = compactor_ss(en)
         return (cflat, lane_id, kvalid)
 
-    @jax.jit
-    def part_fp(rows):
-        # fingerprint AFTER compaction (engine/chunk.py order): gather K
-        # candidate structs, hash those K lanes only.
-        states = jax.vmap(unflatten_state, (0, None))(rows, dims)
-        cands, en, ovf = jax.vmap(expand)(states)
-        cflat = jax.tree.map(
-            lambda a: a.reshape((BG,) + a.shape[2:]), cands)
-        _P, _total, lane_id, kvalid = compactor(en)
-        kstates = jax.tree.map(lambda a: a[lane_id], cflat)
-        kh, kl = jax.vmap(fingerprint)(kstates)
-        return (cflat, kh, kl, lane_id, kvalid)
-
-    @jax.jit
-    def part_insert(seen, kh, kl, kvalid):
-        return fpset.insert(seen, kh, kl, kvalid)
-
-    @jax.jit
-    def part_materialize(cflat, lane_id):
-        kstates = jax.tree.map(lambda a: a[lane_id], cflat)
-        return jax.vmap(flatten_state, (0, None))(kstates, dims)
-
-    @jax.jit
-    def part_enqueue(qnext, next_count, krows, enq):
-        epos = next_count + jnp.cumsum(enq.astype(_I32)) - 1
-        epos = jnp.where(enq, epos, Q + jnp.arange(K, dtype=_I32))
-        qnext = qnext.at[epos].set(krows)
-        return qnext, next_count + jnp.sum(enq, dtype=_I32)
-
-    rows = qcur[:B]
-    bench("expand", part_expand, rows)
-    bench("expand + compact (K lanes)", part_compact, rows)
     bench("expand + compact[searchsorted]", part_compact_ss, rows)
-    _, (cflat, kh, kl, lane_id, kvalid) = bench(
-        "expand + compact + fingerprint (K)", part_fp, rows)
+
+    # ...and the Pallas lowerings, fed from the shared stage programs'
+    # own intermediates (no re-derived pipeline).
+    progs = build_stage_programs(dims, B, K)
+    valid = jnp.ones((B,), bool)
+    cflat, lane_id, kvalid = progs["expand"](rows, valid)
+    kstates, kh, kl = progs["fingerprint"](cflat, lane_id)
     seen = fpset.empty(cfg.seen_capacity)
-    bench("fpset.insert (K keys: sort + probes)", part_insert, seen, kh, kl,
-          kvalid)
     # Pallas sequential-grid insert (ops/fpset_pallas.py): same contract,
     # no sort/claims; prices Mosaic scalar-DMA probing — the datum for
     # NORTHSTAR.md §d's fused-chunk decision.  Tolerant of a Mosaic
@@ -173,11 +141,8 @@ def main():
               fpset_pallas.insert, seen_p, kh, kl, kvalid)
     except Exception as e:  # noqa: BLE001 — report, keep profiling
         print(f"fpset_pallas.insert                        FAILED: {e!r}")
-    _, krows = bench("materialize K rows (gather+flatten)",
-                     part_materialize, cflat, lane_id)
+    krows = jax.vmap(flatten_state, (0, None))(kstates, dims)
     qnext = jnp.zeros((QA, SW), jnp.uint8)
-    bench("enqueue scatter (K rows)", part_enqueue, qnext, jnp.int32(0),
-          krows, kvalid)
     # Pallas run-coalesced enqueue (ops/enqueue_pallas.py): the
     # contiguous-append formulation of the 14.5 ms scatter stage —
     # the other half of NORTHSTAR §d's fused-chunk pricing.

@@ -5,8 +5,16 @@ measurement here loops the op N times inside ONE jitted ``lax.fori_loop``
 (data-chained so iterations can't collapse) and ends with a host fetch of a
 scalar — a true barrier.  Reported per-iteration time subtracts nothing;
 with N=8 the dispatch+RTT overhead is amortized to noise.
+
+``TB_JSON=path`` additionally writes the measurements as one JSON
+object in the bench.py dialect — ``ms`` (this script's fori-loop
+numbers), ``chunk_stages`` (the shared obs/profile.py staged
+decomposition over the same warm frontier), and ``coverage`` (the
+warm run's TLC-style per-action object) — so scripts/bench_diff.py
+can gate tunnel-measured trajectories exactly like bench.py ones.
 """
 
+import json
 import os
 import sys
 import time
@@ -29,6 +37,9 @@ from raft_tla_tpu.utils.cfg import load_config
 
 N = 4
 
+#: name -> ms/iter, what TB_JSON serializes.
+RESULTS = {}
+
 
 def timed(name, jitted, *args):
     out = jitted(*args)
@@ -38,6 +49,7 @@ def timed(name, jitted, *args):
     _ = float(np.asarray(jax.tree.leaves(out)[0]).ravel()[0])  # barrier
     dt = (time.time() - t0) / N * 1e3
     print(f"{name:46s} {dt:9.2f} ms/iter")
+    RESULTS[name] = round(dt, 3)
     return dt
 
 
@@ -63,7 +75,7 @@ def main():
         batch=B, queue_capacity=1 << 20, seen_capacity=1 << 23,
         record_trace=False, check_deadlock=False, max_diameter=4,
         events_out=os.path.join(scratch_dir, "events.jsonl")))
-    warm.run(initial_states(setup))
+    wres = warm.run(initial_states(setup))
     # Engine-resolved path + cleanup-on-both-outcomes, shared with
     # bench.py (obs.validate_and_cleanup).
     from raft_tla_tpu.obs import validate_and_cleanup
@@ -194,6 +206,33 @@ def main():
         return jax.lax.fori_loop(0, N, body, jnp.int32(0))
 
     timed("row-gather 270k x 473", loop_gather_rows, crows, enf)
+
+    out_path = os.environ.get("TB_JSON")
+    if out_path:
+        # bench.py-dialect JSON: chunk_stages + coverage are the two
+        # axes scripts/bench_diff.py gates on; "ms" carries this
+        # script's own fori-loop numbers for eyeballing.
+        from raft_tla_tpu.obs.profile import profile_stages
+        stage_means = profile_stages(
+            dims, np.asarray(rows), seen_capacity=1 << 23, n=max(N, 2))
+        doc = {
+            "metric": "true_bench_ms",
+            "value": RESULTS.get("expand+flatten+fingerprint", 0.0),
+            "unit": "ms/iter",
+            "platform": jax.devices()[0].platform,
+            "batch": B,
+            "n_iters": N,
+            "ms": RESULTS,
+            "chunk_stages": {k: round(v, 6)
+                             for k, v in stage_means.items()},
+            "coverage": wres.coverage,
+            "distinct_states": wres.distinct,
+            "generated_states": wres.generated,
+        }
+        with open(out_path, "w", encoding="utf-8") as f:
+            json.dump(doc, f, indent=1)
+            f.write("\n")
+        print(f"true_bench: wrote {out_path}")
 
 
 if __name__ == "__main__":

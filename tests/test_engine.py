@@ -53,6 +53,19 @@ def test_counts_match_oracle_through_level3(engine):
     # generated total.
     assert sum(res.action_counts.values()) == res.generated
     assert res.action_counts.get("Timeout", 0) > 0
+    # TLC-style coverage (obs/coverage.py) derives from the same packed
+    # stats: generated matches action_counts bit-exactly, distinct
+    # partitions the distinct count minus the root, and disabled counts
+    # close the guard-evaluation accounting per family size.
+    cov = res.coverage
+    assert {a: v["generated"] for a, v in cov.items()} == res.action_counts
+    assert sum(v["generated"] for v in cov.values()) == res.generated
+    assert sum(v["distinct"] for v in cov.values()) == res.distinct - 1
+    sizes = dict(zip(DIMS.family_names, DIMS.family_sizes))
+    expanded = {name: (v["generated"] + v["disabled"]) / sizes[name]
+                for name, v in cov.items()}
+    assert len(set(expanded.values())) == 1   # one shared expanded base
+    assert next(iter(expanded.values())) > 0
 
 
 def test_violation_found_at_min_depth_and_replays():

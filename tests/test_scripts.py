@@ -72,3 +72,86 @@ def test_bench_runs_with_tiny_budget():
     # carries; the script itself exits nonzero if the run's event log is
     # missing or malformed, so reaching here also proves that gate.
     assert rec["phases"] and "stats_fetch" in rec["phases"]
+
+
+# ---------------------------------------------------------------------------
+# scripts/bench_diff.py — the regression gate (no jax; imported in-process
+# so the rc contract is tested without a subprocess per case).
+
+def _bench_diff_main():
+    import importlib.util
+    spec = importlib.util.spec_from_file_location(
+        "bench_diff", os.path.join(REPO, "scripts", "bench_diff.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod.main
+
+
+def _fake_bench(value=1000.0, gen=4000.0, **over):
+    doc = {"metric": "distinct_states_per_sec", "value": value,
+           "unit": "states/s", "generated_per_sec": gen,
+           "distinct_states": 100000,
+           "phases": {"chunk": 40.0, "stats_fetch": 5.0, "warmup": 2.0},
+           "chunk_stages": {"expand": 0.050, "fingerprint": 0.010,
+                            "dedup_insert": 0.015, "enqueue": 0.020,
+                            "total": 0.060},
+           "coverage": {"Timeout": {"generated": 600, "distinct": 300,
+                                    "disabled": 0},
+                        "Receive": {"generated": 400, "distinct": 100,
+                                    "disabled": 200}}}
+    doc.update(over)
+    return doc
+
+
+def test_bench_diff_trajectory_and_self_compare_pass(capsys):
+    main = _bench_diff_main()
+    # The real BENCH_r* trajectory (wrapper form) must stay green...
+    assert main([os.path.join(REPO, "BENCH_r04.json"),
+                 os.path.join(REPO, "BENCH_r05.json")]) == 0
+    # ...and self-compare is exactly zero-delta.
+    assert main([os.path.join(REPO, "BENCH_r05.json"),
+                 os.path.join(REPO, "BENCH_r05.json")]) == 0
+    assert "PASS" in capsys.readouterr().out
+
+
+def test_bench_diff_flags_regressions(tmp_path, capsys):
+    main = _bench_diff_main()
+    old, new = tmp_path / "old.json", tmp_path / "new.json"
+    old.write_text(json.dumps(_fake_bench()))
+    # 2x headline slowdown -> rc 1 (the acceptance case).
+    new.write_text(json.dumps(_fake_bench(value=500.0, gen=2000.0)))
+    assert main([str(old), str(new)]) == 1
+    assert "REGRESSION" in capsys.readouterr().out
+    # A single chunk stage blowing past its threshold -> rc 1.
+    stages = dict(_fake_bench()["chunk_stages"], dedup_insert=0.200)
+    new.write_text(json.dumps(_fake_bench(chunk_stages=stages)))
+    assert main([str(old), str(new)]) == 1
+    assert "dedup_insert" in capsys.readouterr().out
+    # Coverage-mix drift (action shares shifted well past 5 pts) -> rc 1.
+    cov = {"Timeout": {"generated": 100, "distinct": 50, "disabled": 0},
+           "Receive": {"generated": 900, "distinct": 200, "disabled": 0}}
+    new.write_text(json.dumps(_fake_bench(coverage=cov)))
+    assert main([str(old), str(new)]) == 1
+    assert "coverage mix drift" in capsys.readouterr().out
+    # Within-threshold wobble passes.
+    new.write_text(json.dumps(_fake_bench(value=950.0, gen=3900.0)))
+    assert main([str(old), str(new)]) == 0
+    # Thresholds are configurable: the same wobble fails at 1%.
+    assert main([str(old), str(new), "--max-regress", "0.01"]) == 1
+
+
+def test_bench_diff_malformed_inputs_exit_2(tmp_path, capsys):
+    main = _bench_diff_main()
+    ok = tmp_path / "ok.json"
+    ok.write_text(json.dumps(_fake_bench()))
+    # Missing file.
+    assert main([str(tmp_path / "nope.json"), str(ok)]) == 2
+    # Not JSON at all.
+    bad = tmp_path / "bad.json"
+    bad.write_text("{nope")
+    assert main([str(bad), str(ok)]) == 2
+    # A BENCH_r* wrapper whose run never emitted JSON (parsed: null).
+    bad.write_text(json.dumps({"cmd": "x", "rc": 1, "parsed": None}))
+    assert main([str(ok), str(bad)]) == 2
+    err = capsys.readouterr().err
+    assert "bench_diff:" in err
