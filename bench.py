@@ -124,6 +124,12 @@ def main():
     # decomposition bench_diff.py gates on.  BENCH_PROFILE_CHUNKS=0
     # disables; engine results are bit-identical either way.
     profile_every = int(os.environ.get("BENCH_PROFILE_CHUNKS", "64"))
+    # Partial-order reduction (analysis/por.py): BENCH_POR=1 certifies
+    # in-process at engine build, BENCH_POR_TABLE applies a pre-built
+    # artifact.  The reduction (if any certificate proves) shows up in
+    # the coverage object's "pruned" column and the generated/distinct
+    # headline — bench_diff.py then reports generated-state reduction
+    # alongside the distinct/s regression gate.
     cfg = EngineConfig(
         batch=int(os.environ.get("BENCH_BATCH",
                                  str(2048 if on_accel else 512))),
@@ -134,7 +140,9 @@ def main():
         max_seconds=BENCH_SECONDS,   # host-side; C++ store tracked separately)
         events_out=events_file,
         trace_out=os.environ.get("BENCH_TRACE_OUT"),
-        profile_chunks_every=profile_every or None)
+        profile_chunks_every=profile_every or None,
+        por=bool(int(os.environ.get("BENCH_POR", "0"))),
+        por_table=os.environ.get("BENCH_POR_TABLE"))
     # "auto": on a multi-accelerator slice (e.g. v5e-8) the run shards
     # over all devices — the mesh engine is the product's scaling path
     # and the north-star target is defined on the full slice.
@@ -226,6 +234,9 @@ def main():
         "chunk_stages": {k: round(v, 6)
                          for k, v in res.chunk_stages.items()},
         "coverage": res.coverage,
+        # Certified ample instances the run's POR table carried (0 = POR
+        # off or an all-conservative certificate).
+        "por_instances": res.por_instances,
         "baseline_states_per_sec": round(base_rate, 1),
         "baseline_distinct": ores.distinct_states,
         "baseline_wall_s": round(base_wall, 2),

@@ -2,7 +2,7 @@
 
 Every action kernel is a pure, statically-shaped JAX function over the
 packed ``StateBatch`` encoding, so the *model itself* is analyzable at
-trace time.  Three passes share one jaxpr evaluator (``interp.py``) and
+trace time.  Four passes share one jaxpr evaluator (``interp.py``) and
 one findings/report spine (``report.py``):
 
 - :mod:`.effects` — per-action read/write sets from the kernel jaxprs:
@@ -18,7 +18,11 @@ one findings/report spine (``report.py``):
   fingerprint / FPSet kernels (host callbacks, dynamic shapes,
   non-deterministic reductions, accidental narrowing) plus an AST check
   that the host chunk loop only blocks on device data at sanctioned
-  sync points.
+  sync points, plus an analyzer-vs-analyzer read-set self-check;
+- :mod:`.por` — static partial-order reduction: per-instance ample-set
+  certificates proved from the effects matrices (closure, invariant
+  visibility, cycle proviso), packed into the device-consumable
+  reduction table ``EngineConfig.por`` applies in the expansion stage.
 
 ``run_analysis`` executes the passes and aggregates one
 :class:`~.report.Report`; the ``analyze`` CLI subcommand and the CI
@@ -34,12 +38,12 @@ from typing import List, Optional
 from .report import ERROR, INFO, Report, WARNING  # noqa: F401
 
 #: Pass registry, in execution order.
-PASSES = ("effects", "bounds", "lint")
+PASSES = ("effects", "bounds", "lint", "por")
 
 
 def run_analysis(dims, bounds=None, init_states=None,
                  passes=PASSES, allowlist: Optional[List[str]] = None,
-                 lane_caps=None, lint_targets=None,
+                 lane_caps=None, lint_targets=None, invariant_names=None,
                  metrics=None, evlog=None) -> Report:
     """Run the requested passes over one model.
 
@@ -47,16 +51,22 @@ def run_analysis(dims, bounds=None, init_states=None,
     ``init_states`` concrete roots to seed the bounds fixpoint (None or
     randomized-smoke roots fall back to the declared domain envelope),
     ``lane_caps``/``lint_targets`` are test/fixture overrides passed to
-    their passes.  ``metrics`` (MetricsRegistry) and ``evlog``
-    (RunEventLog) receive the per-pass telemetry when given."""
+    their passes, ``invariant_names`` the cfg's INVARIANT list for the
+    POR visibility condition (None = the conservative full registry).
+    ``metrics`` (MetricsRegistry) and ``evlog`` (RunEventLog) receive
+    the per-pass telemetry when given."""
     report = Report(model={"dims": repr(dims),
                            "model_class": type(dims).__name__},
                     allowlist=allowlist)
+    # The effects summary is shared downstream: lint's read-set
+    # self-check and por's certificates consume the SAME matrices the
+    # effects pass serialized (no re-tracing within one invocation).
+    eff_summary = None
     for name in passes:
         if name == "effects":
             from . import effects
-            summary, findings = effects.analyze(dims)
-            summary = effects.summary_json(summary)
+            eff_summary, findings = effects.analyze(dims)
+            summary = effects.summary_json(eff_summary)
         elif name == "bounds":
             from . import bounds as bounds_mod
             summary, findings = bounds_mod.analyze(
@@ -64,7 +74,13 @@ def run_analysis(dims, bounds=None, init_states=None,
                 lane_caps=lane_caps)
         elif name == "lint":
             from . import lint
-            summary, findings = lint.analyze(dims, targets=lint_targets)
+            summary, findings = lint.analyze(dims, targets=lint_targets,
+                                             effect_summary=eff_summary)
+        elif name == "por":
+            from . import por
+            summary, findings = por.analyze(
+                dims, bounds=bounds, invariant_names=invariant_names,
+                effect_summary=eff_summary)
         else:
             raise ValueError(f"unknown analysis pass {name!r}; "
                              f"registered: {PASSES}")

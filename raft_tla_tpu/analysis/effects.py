@@ -186,10 +186,45 @@ def _dead_lanes(dims, instances) -> Dict[str, np.ndarray]:
     return {f: ~w for f, w in written.items()}
 
 
+def _pack_matrix_hex(mat: np.ndarray) -> List[str]:
+    """[G,G] bool -> one hex bitmask string per row (bit h = column h).
+    Stable, compact serialization for the analyze report — the POR pass
+    and future BLEST-style batching consume this artifact instead of
+    re-tracing the kernels."""
+    out = []
+    for row in np.asarray(mat, bool):
+        v = 0
+        for h in np.nonzero(row)[0]:
+            v |= 1 << int(h)
+        out.append(format(v, "x"))
+    return out
+
+
+def _unpack_matrix_hex(rows: List[str], G: int) -> np.ndarray:
+    mat = np.zeros((G, G), bool)
+    for g, hexrow in enumerate(rows):
+        v = int(hexrow, 16)
+        while v:
+            h = v.bit_length() - 1
+            mat[g, h] = True
+            v &= ~(1 << h)
+    return mat
+
+
+def matrices_from_json(summary: dict) -> Tuple[np.ndarray, np.ndarray]:
+    """(independent, guard_independent) matrices from a serialized
+    effects report (``summary_json`` output) — the stable consumer-side
+    decoder for POR/BLEST tooling."""
+    G = summary["n_instances"]
+    return (_unpack_matrix_hex(summary["independent_hex"], G),
+            _unpack_matrix_hex(summary["guard_independent_hex"], G))
+
+
 def summary_json(summary: EffectSummary) -> dict:
-    """Compact JSON view: per-family sets, matrix statistics, and the
-    family-level independent pairs (the full G x G matrix is returned by
-    :func:`analyze` for programmatic use, not serialized)."""
+    """Compact JSON view: per-family sets, matrix statistics, the
+    family-level independent pairs, and the full per-instance dependence
+    / guard-independence matrices (hex row bitmasks + instance labels —
+    decode with :func:`matrices_from_json`)."""
     fams = {name: {k: sorted(v) for k, v in d.items()}
             for name, d in summary.families.items()}
     G = len(summary.instances)
@@ -211,6 +246,10 @@ def summary_json(summary: EffectSummary) -> dict:
     return {
         "n_instances": G,
         "families": fams,
+        "instances": [i.label for i in summary.instances],
+        "independent_hex": _pack_matrix_hex(summary.independent),
+        "guard_independent_hex": _pack_matrix_hex(
+            summary.guard_independent),
         "independent_pairs": int(np.triu(summary.independent, 1).sum()),
         "guard_independent_pairs": int(
             np.triu(summary.guard_independent, 1).sum()),

@@ -1,6 +1,6 @@
 """Hot-loop lint: TPU-throughput hazards in the compiled step + host loop.
 
-Two halves, one pass:
+Three halves, one pass:
 
 - **Jaxpr lint**: trace the BFS chunk body (the per-batch pipeline the
   engines run thousands of times per second — both the v1 expand path
@@ -25,6 +25,13 @@ Two halves, one pass:
   every sync visible in the phase breakdown), or inside a branch that
   exits the loop (violation / deadlock reporting runs once, off the
   steady state).
+
+- **Read-set self-check**: analyzer-vs-analyzer consistency — any state
+  lane a kernel jaxpr demonstrably reads (consumed by a non-identity
+  primitive on the way to the outputs) must be inside the read set the
+  effects pass reports for that family.  A mismatch means the taint
+  interpreter dropped a dependency, which would make downstream
+  consumers (the POR certificates) unsound — ERROR.
 
 Everything here is trace/parse-time only: no device execution, no
 compilation — safe to run in CI on a CPU-only runner.
@@ -195,11 +202,12 @@ def _trace_engine_kernels(dims, batch: int = 4):
             jax.ShapeDtypeStruct((), jnp.uint32),
             jax.ShapeDtypeStruct((), jnp.uint32),
             jax.ShapeDtypeStruct((), jnp.bool_),
-            # fam_counts, fam_new (coverage), expanded — the 21-field
-            # carry (engine/chunk.py layout).
+            # fam_counts, fam_new (coverage), expanded, fam_pruned (POR)
+            # — the 22-field carry (engine/chunk.py layout).
             jax.ShapeDtypeStruct((len(dims.family_sizes),), jnp.int32),
             jax.ShapeDtypeStruct((len(dims.family_sizes),), jnp.int32),
-            i32)
+            i32,
+            jax.ShapeDtypeStruct((len(dims.family_sizes),), jnp.int32))
 
     qcur = jax.ShapeDtypeStruct((QA, sw), jnp.uint8)
     cnt = jax.ShapeDtypeStruct((), jnp.int32)
@@ -221,6 +229,103 @@ def _trace_engine_kernels(dims, batch: int = 4):
         v2 = None
     if v2 is not None:
         yield "bfs_step_v2", step_jaxpr(v2)
+
+
+# ---------------------------------------------------------------------------
+# Analyzer-vs-analyzer read-set self-check
+#
+# The effects pass's read sets feed the POR certificates, so a taint
+# dependency silently dropped by the interpreter would turn into an
+# unsound reduction.  This check re-derives a SYNTACTIC read set per
+# action family — every state invar consumed by at least one
+# non-value-preserving primitive on the way to the outputs — and flags
+# any lane the jaxpr demonstrably reads that the effects pass does not
+# report.  Pure pass-through (an unchanged successor field flowing
+# identically to an outvar) is not a read; that is exactly the
+# distinction the taint domain draws, so the two analyzers must agree.
+
+#: Primitives that move values without consuming them (reshape-like).
+_IDENTITY_PRIMS = frozenset({
+    "copy", "reshape", "squeeze", "expand_dims", "transpose", "rev",
+    "broadcast_in_dim", "convert_element_type", "stop_gradient", "slice",
+})
+
+_CALL_PRIMS = ("pjit", "closed_call", "core_call", "remat", "checkpoint",
+               "custom_jvp_call", "custom_vjp_call")
+
+
+def syntactic_real_reads(closed, n_state: int) -> set:
+    """Indices (0..n_state-1) of state invars consumed by a non-identity
+    primitive anywhere in the jaxpr (recursing into call sub-jaxprs)."""
+    reads: set = set()
+
+    def walk(jaxpr, env):
+        for eqn in jaxpr.eqns:
+            from .interp import _literal_cls
+            srcs = [env.get(v, frozenset()) for v in eqn.invars
+                    if not isinstance(v, _literal_cls())]
+            union = frozenset().union(*srcs) if srcs else frozenset()
+            name = eqn.primitive.name
+            if name in _CALL_PRIMS:
+                inner = eqn.params.get("jaxpr") or \
+                    eqn.params.get("call_jaxpr")
+                if inner is not None:
+                    ij = getattr(inner, "jaxpr", inner)
+                    sub_env = {}
+                    live = [v for v in eqn.invars
+                            if not isinstance(v, _literal_cls())]
+                    for var, outer in zip(ij.invars, live):
+                        sub_env[var] = env.get(outer, frozenset())
+                    walk(ij, sub_env)
+                    for outv, innerv in zip(eqn.outvars, ij.outvars):
+                        if not isinstance(innerv, _literal_cls()):
+                            env[outv] = sub_env.get(innerv, frozenset())
+                    continue
+            if name in _IDENTITY_PRIMS:
+                for outv in eqn.outvars:
+                    env[outv] = union
+            else:
+                reads.update(union)
+                for outv in eqn.outvars:
+                    env[outv] = union
+
+    jaxpr = closed.jaxpr
+    env = {v: frozenset([k]) for k, v in enumerate(jaxpr.invars[:n_state])}
+    walk(jaxpr, env)
+    return reads
+
+
+def read_set_check(dims, family_reads=None,
+                   effect_summary=None) -> List[Finding]:
+    """Flag any action kernel whose jaxpr reads a packed lane outside
+    the read set the effects pass reports for it.  ``family_reads``
+    overrides the effects-derived ``{family: fields}`` map (tests plant
+    a missing field there to prove the check fires)."""
+    from . import lane_map
+    from .interp import traced_kernels
+    if family_reads is None:
+        if effect_summary is None:
+            from . import effects
+            effect_summary, _f = effects.analyze(dims)
+        family_reads = {
+            fam: d["reads"] | d["guard_reads"]
+            for fam, d in effect_summary.families.items()}
+    findings: List[Finding] = []
+    n_state = len(lane_map.FIELDS)
+    for name, closed, _params in traced_kernels(dims):
+        syn = {lane_map.FIELDS[k]
+               for k in syntactic_real_reads(closed, n_state)}
+        extra = sorted(syn - set(family_reads.get(name, frozenset())))
+        if extra:
+            findings.append(Finding(
+                PASS, ERROR, "read-set-mismatch", field=name,
+                message=f"kernel {name!r} syntactically reads state "
+                        f"field(s) {', '.join(extra)} that the effects "
+                        "pass does not report — the taint interpreter "
+                        "dropped a dependency (POR certificates would "
+                        "be unsound)",
+                details={"extra_reads": extra}))
+    return findings
 
 
 # ---------------------------------------------------------------------------
@@ -347,9 +452,12 @@ def _default_targets() -> List[Tuple[str, Optional[Tuple[str, ...]]]]:
 # The pass
 
 
-def analyze(dims, targets=None) -> Tuple[dict, List[Finding]]:
-    """Run both lint halves.  ``targets`` overrides the host-loop file
-    list (``[(path, scope-or-None), ...]``; tests plant fixtures here)."""
+def analyze(dims, targets=None,
+            effect_summary=None) -> Tuple[dict, List[Finding]]:
+    """Run all lint halves.  ``targets`` overrides the host-loop file
+    list (``[(path, scope-or-None), ...]``; tests plant fixtures here);
+    ``effect_summary`` reuses the effects pass's result for the read-set
+    self-check when both passes run in one invocation."""
     findings: List[Finding] = []
     kernels: Dict[str, dict] = {}
     for kernel, closed in _trace_engine_kernels(dims):
@@ -360,4 +468,7 @@ def analyze(dims, targets=None) -> Tuple[dict, List[Finding]]:
     for path, scope in (_default_targets() if targets is None else targets):
         findings.extend(scan_host_loops(path, scope))
         scanned.append(os.path.basename(path))
-    return {"kernels": kernels, "host_files": scanned}, findings
+    rs = read_set_check(dims, effect_summary=effect_summary)
+    findings.extend(rs)
+    return {"kernels": kernels, "host_files": scanned,
+            "read_set_mismatches": len(rs)}, findings

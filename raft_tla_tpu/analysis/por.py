@@ -1,0 +1,508 @@
+"""Static partial-order reduction: ample-set certificates from the
+dependence matrices.
+
+The effects pass (``effects.py``) already proves, per action instance,
+element-wise write masks and field-level read sets, and folds them into
+the action dependence matrix.  This pass consumes those matrices and
+asks, for every instance ``g``: *is the singleton ``{g}`` a valid ample
+set at every state where ``g`` is enabled?*  If yes, the engine may
+expand ONLY ``g`` from such a state and provably misses no invariant
+verdict.  Four side conditions, each proved statically or the instance
+is conservatively widened to "never ample" (a WARNING, never a silent
+claim — the ``bounds.py`` contract):
+
+- **C0 non-emptiness** — structural: the engine applies the reduction
+  only at states whose enabled set contains a certified instance, so
+  the chosen ample set is never empty.
+- **C1 closure (stubbornness)** — ``g`` must be independent of EVERY
+  other instance (``effects.independent`` row complete off-diagonal).
+  Independence there means element-disjoint writes and neither touches
+  what the other reads, so ``{g}`` is a persistent set wherever ``g``
+  is enabled: no action executable before ``g`` — now or after any
+  deferred sequence — conflicts with it, and nothing can disable it.
+  Anything weaker is unsound: a dependent action that is merely
+  *disabled right now* can become enabled along a deferred path and
+  observe ``g``'s writes (see tests for the concrete counterexample
+  family), so no enabled-set-only refinement is offered.
+- **C2 invariant visibility** — ``g``'s written fields must be disjoint
+  from the read set of every checked predicate: the configured
+  INVARIANTs (models/invariants.py TypeOK + the models/safety.py suite
+  by default) AND the cfg CONSTRAINT (constraint reads gate expansion).
+  Read sets are traced through the same jaxpr taint interpreter as the
+  effects pass, so a predicate's footprint can never silently drift
+  from its kernel.  Without this condition a pruned sibling state could
+  carry the only violating valuation.
+- **C3 cycle proviso** — ``g`` must be provably *self-disabling*: the
+  kernel's guard, re-evaluated under the interval domain on ``g``'s own
+  successor envelope, must be must-false.  Together with C1 (no other
+  instance writes ``g``'s guard reads) this kills the ignoring problem:
+  an ample-only path can execute each certified instance at most once,
+  so no cycle of the reduced graph consists solely of ample steps, and
+  a certified instance can never produce a pruning self-loop (if
+  ``s·g = s`` then ``g`` would still be enabled at ``s·g``,
+  contradicting the proof).
+
+On the base Raft alphabet this is an honest negative result: every
+instance fails C1 because ``Receive``'s reply-slot allocation scans the
+whole message bag (conservative whole-field ``msg``/server-field
+writes), making it statically dependent on every other family — the
+pass reports exactly which conditions block each family instead of
+claiming a reduction it cannot prove.  The machinery (certificates,
+packed device table, engine masking, coverage accounting) is exercised
+end-to-end by the oracle differentials in ``tests/test_por.py``; finer
+read/write granularity can flip families to certified without touching
+the engine.
+
+The emitted :class:`PorTable` is the device-consumable artifact: a
+per-instance ``ample_mask`` + ``priority`` order packed for the engines
+(``EngineConfig.por`` / ``por_table``), serialized into the ``analyze
+--json`` report and an optional versioned artifact file.  The table is
+fingerprinted over its full payload; the engine re-verifies fingerprint,
+model signature, and predicate coverage before applying a mask, so a
+hand-edited certificate is rejected, never silently trusted.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import json
+from typing import Dict, FrozenSet, List, Optional, Tuple
+
+import numpy as np
+
+from . import lane_map
+from .interp import (IntervalDomain, TaintDomain, _ival, eval_jaxpr,
+                     traced_kernels)
+from .report import ERROR, Finding, INFO, WARNING
+
+PASS = "por"
+TABLE_VERSION = 1
+
+#: C1/C2/C3 condition names, report order.
+CONDITIONS = ("nonempty", "closure", "visibility", "proviso")
+
+
+# ---------------------------------------------------------------------------
+# Predicate read sets (invariant-visibility inputs)
+
+
+def trace_predicate(kernel, dims):
+    """Trace one state predicate ``kernel(StateBatch) -> bool`` to a
+    ClosedJaxpr over the 13 abstract state fields (lane_map.FIELDS
+    order) — the invariant-side twin of ``interp.trace_family``."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..models.schema import StateBatch
+
+    shapes = lane_map.field_shapes(dims)
+
+    def flat(*fields):
+        return kernel(StateBatch(*fields))
+
+    in_avals = [jax.ShapeDtypeStruct(shapes[f], jnp.int32)
+                for f in lane_map.FIELDS]
+    return jax.make_jaxpr(flat)(*in_avals)
+
+
+def predicate_read_sets(dims, predicates) -> Tuple[Dict[str, FrozenSet[str]],
+                                                   List[str]]:
+    """``{name: fields the predicate may read}`` for ``[(name, kernel)]``,
+    via the taint domain (sound: a dropped dependency would be an interp
+    bug — the lint pass's read-set self-check guards the same property
+    on the action kernels).  Also returns the domain's imprecision
+    notes."""
+    from .effects import _state_taints
+    domain = TaintDomain()
+    state = _state_taints(dims)
+    out: Dict[str, FrozenSet[str]] = {}
+    for name, kernel in predicates:
+        closed = trace_predicate(kernel, dims)
+        res = eval_jaxpr(closed, list(state), domain)
+        out[name] = frozenset(res[0].deps)
+    return out, list(domain.notes)
+
+
+# ---------------------------------------------------------------------------
+# C3: self-disabling proof (interval domain)
+
+
+def _envelope_intervals(dims, bounds=None):
+    """Declared per-field domains (lane_map.field_domains — the same
+    widening envelope the bounds pass uses), intersected with the cfg's
+    CONSTRAINT clamps, as interval-domain state values."""
+    domains = lane_map.field_domains(dims)
+    clamps = lane_map.constraint_bounds(dims, bounds)
+    shapes = lane_map.field_shapes(dims)
+    out = []
+    for f in lane_map.FIELDS:
+        lo, hi = domains[f]
+        lo = np.broadcast_to(np.asarray(lo, np.int64), shapes[f])
+        hi = np.broadcast_to(np.asarray(hi, np.int64), shapes[f])
+        if f in clamps:
+            clo, chi = clamps[f]
+            lo = np.maximum(lo, clo)
+            hi = np.minimum(hi, chi)
+        out.append(_ival(lo, hi, np.int32))
+    return out
+
+
+def self_disabling(closed, params, env_state) -> Tuple[bool, List[str]]:
+    """Prove the instance's guard false on its own successors.
+
+    Evaluates the family jaxpr once on the reachable envelope (successor
+    intervals over-approximate every ``g``-successor of every state in
+    the envelope), then re-evaluates the same jaxpr on those successor
+    intervals and requires the ``enabled`` output to be must-false.
+    Conservative both ways: an imprecision widens the guard toward
+    "maybe enabled" and the proof simply fails."""
+    domain = IntervalDomain()
+    pvals = [np.int32(v) for v in params]
+    outs = eval_jaxpr(closed, list(env_state) + pvals, domain)
+    succ = outs[2:]
+    outs2 = eval_jaxpr(closed, list(succ) + pvals, domain)
+    en2 = outs2[0]
+    proved = bool(np.all(np.asarray(en2.hi) == 0))
+    return proved, list(domain.notes)
+
+
+# ---------------------------------------------------------------------------
+# Certificates and the packed table
+
+
+@dataclasses.dataclass
+class Certificate:
+    """Per-instance ample-set certificate: condition -> (proved, why)."""
+
+    grid_index: int
+    family: str
+    label: str
+    conditions: Dict[str, Tuple[bool, str]]
+
+    @property
+    def ample(self) -> bool:
+        return all(ok for ok, _why in self.conditions.values())
+
+    def blocking(self) -> List[str]:
+        return [c for c in CONDITIONS if not self.conditions[c][0]]
+
+
+@dataclasses.dataclass
+class PorTable:
+    """The device-consumable reduction table (versioned artifact).
+
+    ``ample_mask[g]`` — instance ``g`` is a certified singleton ample
+    set wherever enabled; ``priority[g]`` — selection order when several
+    certified instances are enabled in one state (lowest value wins;
+    grid order by default, reorderable by future cost models without a
+    schema change).  ``predicates`` names every state predicate the
+    visibility condition was proved against — a run checking anything
+    outside this list must reject the table.  ``fingerprint`` is a
+    sha256 over the canonical payload: a hand-edited mask no longer
+    matches and is rejected at load (tests plant exactly that)."""
+
+    model: str
+    n_instances: int
+    ample_mask: np.ndarray          # [G] bool
+    priority: np.ndarray            # [G] int32
+    predicates: Tuple[str, ...]
+    version: int = TABLE_VERSION
+
+    def __post_init__(self):
+        self.ample_mask = np.asarray(self.ample_mask, bool)
+        self.priority = np.asarray(self.priority, np.int32)
+        if self.ample_mask.shape != (self.n_instances,) \
+                or self.priority.shape != (self.n_instances,):
+            raise ValueError("table arrays must be [n_instances]")
+
+    @property
+    def certified(self) -> int:
+        return int(self.ample_mask.sum())
+
+    def payload(self) -> dict:
+        return {"version": self.version, "model": self.model,
+                "n_instances": self.n_instances,
+                "predicates": sorted(self.predicates),
+                "ample_mask": [int(b) for b in self.ample_mask],
+                "priority": [int(p) for p in self.priority]}
+
+    @property
+    def fingerprint(self) -> str:
+        blob = json.dumps(self.payload(), sort_keys=True,
+                          separators=(",", ":")).encode()
+        return hashlib.sha256(blob).hexdigest()
+
+    def to_json(self) -> dict:
+        out = self.payload()
+        out["fingerprint"] = self.fingerprint
+        return out
+
+    @classmethod
+    def from_json(cls, d: dict) -> "PorTable":
+        if d.get("version") != TABLE_VERSION:
+            raise ValueError(
+                f"POR table version {d.get('version')!r} != supported "
+                f"{TABLE_VERSION}; regenerate with `analyze --passes por`")
+        table = cls(model=d["model"], n_instances=int(d["n_instances"]),
+                    ample_mask=np.asarray(d["ample_mask"], bool),
+                    priority=np.asarray(d["priority"], np.int32),
+                    predicates=tuple(d["predicates"]))
+        want = d.get("fingerprint")
+        if want != table.fingerprint:
+            raise ValueError(
+                "POR table fingerprint mismatch (edited by hand, or "
+                "truncated): the certificate no longer matches its "
+                "payload; regenerate with `analyze --passes por "
+                "--por-artifact FILE`")
+        return table
+
+    def save(self, path: str) -> None:
+        with open(path, "w") as f:
+            json.dump(self.to_json(), f, indent=2, sort_keys=True)
+            f.write("\n")
+
+
+def load_table(path: str) -> PorTable:
+    with open(path) as f:
+        return PorTable.from_json(json.load(f))
+
+
+def check_table(table: PorTable, dims, invariant_names=None,
+                has_constraint: bool = False) -> None:
+    """Engine-side admission check: model signature, instance count, and
+    predicate coverage.  Raises ValueError on any mismatch — a reduction
+    certified for a different model (or for fewer predicates than the
+    run checks) must never be applied."""
+    if table.model != repr(dims):
+        raise ValueError(
+            f"POR table was certified for model {table.model!r}, "
+            f"engine runs {repr(dims)!r}")
+    if table.n_instances != dims.n_instances:
+        raise ValueError(
+            f"POR table covers {table.n_instances} action instances, "
+            f"model has {dims.n_instances}")
+    missing = sorted(set(invariant_names or []) - set(table.predicates))
+    if missing:
+        raise ValueError(
+            f"POR table visibility was not proved against checked "
+            f"invariant(s) {missing}; certified predicates: "
+            f"{sorted(table.predicates)}")
+    if has_constraint:
+        # Strict like the invariant check above (even for an all-
+        # conservative mask): a certificate applied outside the
+        # predicate set it was proved under is a config error worth
+        # surfacing before it matters.
+        from ..models.invariants import CONSTRAINT_PREDICATE
+        if CONSTRAINT_PREDICATE not in table.predicates:
+            raise ValueError(
+                "POR table was certified without a CONSTRAINT predicate "
+                "but the run applies one; constraint reads gate "
+                "expansion and must be part of the visibility condition")
+
+
+# ---------------------------------------------------------------------------
+# The pass
+
+
+def _build_certificates(dims, summary, read_sets, bounds):
+    """One :class:`Certificate` per action instance."""
+    instances = summary.instances
+    G = len(instances)
+    indep = summary.independent
+    pred_reads: FrozenSet[str] = frozenset().union(*read_sets.values()) \
+        if read_sets else frozenset()
+    env = _envelope_intervals(dims, bounds)
+    kernels = {name: (closed, params)
+               for name, closed, params in traced_kernels(dims)}
+    # Per-(family, param row) proviso proofs — instances of one family
+    # share a jaxpr, so memoize on the concrete parameter tuple.
+    proviso_cache: Dict[Tuple, Tuple[bool, List[str]]] = {}
+
+    certs: List[Certificate] = []
+    for g, inst in enumerate(instances):
+        conds: Dict[str, Tuple[bool, str]] = {}
+        # C0: the engine masks only states where this instance is
+        # enabled, so the chosen ample set is non-empty by construction.
+        conds["nonempty"] = (True, "ample applied only where enabled")
+
+        dep_fams = sorted({instances[h].family for h in range(G)
+                           if h != g and not indep[g, h]})
+        if dep_fams:
+            conds["closure"] = (
+                False, "statically dependent on instance(s) of "
+                       f"{', '.join(dep_fams)} — a deferred dependent "
+                       "action could observe this instance's writes")
+        else:
+            conds["closure"] = (True, "independent of every other "
+                                      "instance (persistent singleton)")
+
+        vis = sorted(set(inst.writes) & pred_reads)
+        if vis:
+            blockers = sorted(name for name, reads in read_sets.items()
+                              if set(inst.writes) & reads)
+            conds["visibility"] = (
+                False, f"writes {', '.join(vis)} read by checked "
+                       f"predicate(s) {', '.join(blockers)}")
+        else:
+            conds["visibility"] = (True, "writes invisible to every "
+                                         "checked predicate")
+
+        closed, params_arrays = kernels[inst.family]
+        row = tuple(int(np.asarray(p)[g - dims.family_offsets[
+            dims.family_names.index(inst.family)]])
+            for p in params_arrays)
+        key = (inst.family, row)
+        if key not in proviso_cache:
+            proviso_cache[key] = self_disabling(closed, row, env)
+        proved, _notes = proviso_cache[key]
+        conds["proviso"] = (
+            (True, "guard proved false on own successors "
+                   "(self-disabling)") if proved else
+            (False, "cannot prove the guard false on the instance's own "
+                    "successors — an ample chain could ignore deferred "
+                    "actions"))
+        certs.append(Certificate(grid_index=g, family=inst.family,
+                                 label=inst.label, conditions=conds))
+    return certs
+
+
+def _verify_certified(certs, summary, read_sets, dims,
+                      bounds) -> List[Finding]:
+    """Defense-in-depth re-check of every CERTIFIED instance against the
+    raw inputs: C1 straight off the dependence matrix, C2 off the
+    predicate read sets, and C3 by re-running the self-disabling proof
+    with the instance parameters re-derived through ``instance_info``
+    (independent of the builder's offset arithmetic and its memoization).
+    Any failure is an ERROR — the pass then exits nonzero rather than
+    emitting a table whose side conditions do not hold."""
+    findings = []
+    pred_reads = frozenset().union(*read_sets.values()) if read_sets \
+        else frozenset()
+    G = len(summary.instances)
+    if any(c.ample for c in certs):
+        env = _envelope_intervals(dims, bounds)
+        kernels = {name: closed
+                   for name, closed, _p in traced_kernels(dims)}
+    for cert in certs:
+        if not cert.ample:
+            continue
+        g = cert.grid_index
+        fam_code, params = dims.instance_info(g)
+        row = tuple(params.values())
+        proviso_ok, _n = self_disabling(
+            kernels[dims.family_names[fam_code]], row, env)
+        ok = int(summary.independent[g].sum()) == G - 1 \
+            and not (set(summary.instances[g].writes) & pred_reads) \
+            and proviso_ok
+        if not ok:
+            findings.append(Finding(
+                PASS, ERROR, "certificate-unsound",
+                witness=cert.label,
+                message=f"certificate for {cert.label} fails re-"
+                        "verification against the dependence matrix / "
+                        "predicate read sets / proviso proof — refusing "
+                        "to emit the reduction table"))
+    return findings
+
+
+def analyze(dims, bounds=None, invariant_names=None, invariants=None,
+            constraint=None, effect_summary=None
+            ) -> Tuple[dict, List[Finding]]:
+    """Run the POR pass.  Returns ``(summary_json, findings)``; the
+    packed table rides in ``summary_json["table"]``.
+
+    ``invariants`` (name -> kernel dict) takes precedence over
+    ``invariant_names`` (registry lookup; None = the conservative full
+    suite); ``constraint`` is the evaluated CONSTRAINT kernel (falls
+    back to one built from ``bounds``).  ``effect_summary`` reuses the
+    effects pass's live result when both passes run in one invocation."""
+    from ..models.invariants import CONSTRAINT_PREDICATE, \
+        checkable_predicates
+    from . import effects
+
+    findings: List[Finding] = []
+    if effect_summary is None:
+        effect_summary, _eff_findings = effects.analyze(dims)
+
+    if invariants is not None:
+        predicates = list(invariants.items())
+        if constraint is not None:
+            predicates.append((CONSTRAINT_PREDICATE, constraint))
+    else:
+        predicates = checkable_predicates(
+            dims, invariant_names=invariant_names, bounds=bounds,
+            constraint=constraint)
+    read_sets, notes = predicate_read_sets(dims, predicates)
+    for note in notes:
+        findings.append(Finding(
+            PASS, INFO, "analysis-imprecision",
+            message="predicate read-set extraction fell back to a "
+                    f"conservative rule ({note}); read sets remain "
+                    "sound but may over-approximate"))
+
+    certs = _build_certificates(dims, effect_summary, read_sets, bounds)
+    findings.extend(_verify_certified(certs, effect_summary, read_sets,
+                                      dims, bounds))
+
+    # Aggregate per family: one WARNING per widened family (conservative
+    # toward full expansion), one INFO per certified family.
+    by_family: Dict[str, List[Certificate]] = {}
+    for c in certs:
+        by_family.setdefault(c.family, []).append(c)
+    fam_json = {}
+    for fam, group in by_family.items():
+        n_cert = sum(c.ample for c in group)
+        blocked: Dict[str, int] = {}
+        for c in group:
+            for cond in c.blocking():
+                blocked[cond] = blocked.get(cond, 0) + 1
+        fam_json[fam] = {"instances": len(group), "certified": n_cert,
+                         "blocked_by": blocked}
+        if n_cert == len(group):
+            findings.append(Finding(
+                PASS, INFO, "por-certified", field=fam,
+                message=f"all {len(group)} instance(s) of {fam} carry a "
+                        "proved ample certificate",
+                details={"instances": len(group)}))
+        else:
+            first = next(c for c in group if not c.ample)
+            cond = first.blocking()[0]
+            findings.append(Finding(
+                PASS, WARNING, "por-widened", field=fam,
+                witness=first.label,
+                message=f"{fam}: {len(group) - n_cert}/{len(group)} "
+                        f"instance(s) widened to full expansion — "
+                        f"{cond} unproved: "
+                        f"{first.conditions[cond][1]}",
+                details={"blocked_by": blocked}))
+
+    mask = np.array([c.ample for c in certs], bool)
+    priority = np.arange(len(certs), dtype=np.int32)
+    table = PorTable(model=repr(dims), n_instances=len(certs),
+                     ample_mask=mask, priority=priority,
+                     predicates=tuple(name for name, _k in predicates))
+    summary = {
+        "n_instances": len(certs),
+        "certified": table.certified,
+        "predicates": {name: sorted(fields)
+                       for name, fields in read_sets.items()},
+        "families": fam_json,
+        "table": table.to_json(),
+    }
+    return summary, findings
+
+
+def build_table(dims, bounds=None, invariant_names=None, invariants=None,
+                constraint=None, effect_summary=None) -> PorTable:
+    """One-call table construction (the engine's ``por=True`` path).
+    Raises if any certificate fails its side conditions — the same gate
+    as the CLI's nonzero exit."""
+    summary, findings = analyze(
+        dims, bounds=bounds, invariant_names=invariant_names,
+        invariants=invariants, constraint=constraint,
+        effect_summary=effect_summary)
+    errors = [f for f in findings if f.severity == ERROR]
+    if errors:
+        raise ValueError(f"POR certification failed: {errors[0].message}")
+    return PorTable.from_json(summary["table"])

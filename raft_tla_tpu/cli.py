@@ -46,9 +46,22 @@ def _run_analyze(args) -> int:
     gate CI runs.  Exit 0 iff no (un-allowlisted) ERROR finding."""
     import json
 
-    from .analysis import run_analysis
+    from .analysis import PASSES, run_analysis
     from .analysis.lane_map import FIELDS
     from .obs import MetricsRegistry, RunEventLog
+
+    passes = None
+    if args.passes is not None:
+        passes = tuple(p.strip() for p in args.passes.split(",")
+                       if p.strip())
+        unknown = [p for p in passes if p not in PASSES]
+        if unknown or not passes:
+            # Exit 2 (usage error), never a silent no-op run: a typo'd
+            # pass name must not report "analysis OK" on zero passes.
+            print(f"analyze: unknown pass(es) "
+                  f"{', '.join(unknown) or '(none given)'}; valid "
+                  f"passes: {', '.join(PASSES)}", file=sys.stderr)
+            return 2
 
     if args.cfg is not None:
         from .engine.check import initial_states
@@ -56,6 +69,9 @@ def _run_analyze(args) -> int:
         setup = load_config(args.cfg, max_log=args.max_log,
                             n_msg_slots=args.n_msg_slots)
         dims, bounds = setup.dims, setup.bounds
+        # The cfg's INVARIANT list narrows the POR visibility condition
+        # to what this model actually checks.
+        invariant_names = list(setup.invariants)
         # Randomized smoke roots say nothing about the reachable set;
         # the bounds pass then seeds from the declared domain envelope.
         roots = None if setup.smoke else initial_states(setup)
@@ -65,7 +81,7 @@ def _run_analyze(args) -> int:
         dims = RaftDims(n_servers=3, n_values=2,
                         max_log=args.max_log or 8,
                         n_msg_slots=args.n_msg_slots or 32)
-        bounds, roots = None, [init_state(dims)]
+        bounds, roots, invariant_names = None, [init_state(dims)], None
 
     lane_caps = {}
     for spec in args.shrink_lane:
@@ -76,16 +92,37 @@ def _run_analyze(args) -> int:
                 f"got {spec!r}")
         lane_caps[field] = (0, int(hi))
 
-    passes = tuple(args.passes.split(",")) if args.passes else None
     metrics = MetricsRegistry()
     with RunEventLog(args.events_out) as evlog:
         report = run_analysis(
             dims, bounds=bounds, init_states=roots,
             **({"passes": passes} if passes else {}),
             allowlist=args.allow, lane_caps=lane_caps or None,
+            invariant_names=invariant_names,
             metrics=metrics, evlog=evlog)
     if args.out:
         report.write_json(args.out)
+    if args.por_artifact:
+        table = report.pass_summaries.get("por", {}).get("table")
+        if table is None:
+            print("--por-artifact requires the 'por' pass to run "
+                  "(add it to --passes)", file=sys.stderr)
+            return 2
+        unsound = any(f.code == "certificate-unsound"
+                      for f in report.findings if f.pass_name == "por")
+        if unsound:
+            # The pass's certificate-unsound self-check failed: never
+            # materialize a validly-fingerprinted artifact for a mask
+            # whose side conditions did not verify.  Checked on the raw
+            # finding code, not post-allowlist severity — --allow can
+            # un-gate the EXIT status, never the artifact.
+            print("--por-artifact refused: the por pass reported "
+                  "certificate-unsound findings (see report)",
+                  file=sys.stderr)
+        else:
+            with open(args.por_artifact, "w") as f:
+                json.dump(table, f, indent=2, sort_keys=True)
+                f.write("\n")
     if args.json:
         print(json.dumps(report.to_json(), indent=2, sort_keys=True))
     else:
@@ -195,6 +232,18 @@ def main(argv=None):
                         "span per BFS level, the whole run) as Chrome "
                         "trace-event JSON — opens directly in Perfetto / "
                         "chrome://tracing (see README Observability)")
+    c.add_argument("--por", action="store_true",
+                   help="statically-certified partial-order reduction "
+                        "(analysis/por.py): certify ample-set "
+                        "certificates for this model in-process and "
+                        "mask redundant expansions on device.  "
+                        "Conservative: with no provable certificate "
+                        "the run is identical to full expansion")
+    c.add_argument("--por-table", default=None, metavar="FILE",
+                   help="apply a pre-certified POR reduction table "
+                        "(`analyze --passes por --por-artifact FILE`); "
+                        "fingerprint/model/predicate-coverage checked "
+                        "before any mask is applied")
     c.add_argument("--profile-chunks", nargs="?", const=1, type=int,
                    default=None, metavar="N",
                    help="sample every Nth chunk call (default 1 = every "
@@ -230,8 +279,14 @@ def main(argv=None):
                         "(kept visible, marked allowlisted; README "
                         "'Static analysis')")
     a.add_argument("--passes", default=None,
-                   help="comma-separated subset of effects,bounds,lint "
-                        "(default: all)")
+                   help="comma-separated subset of effects,bounds,lint,"
+                        "por (default: all); an unknown pass name exits "
+                        "2 with the valid list")
+    a.add_argument("--por-artifact", default=None, metavar="FILE",
+                   help="write the POR reduction table (versioned, "
+                        "fingerprinted ample_mask + priority) here — "
+                        "the artifact `check --por-table` consumes; "
+                        "requires the 'por' pass")
     a.add_argument("--shrink-lane", action="append", default=[],
                    metavar="FIELD=HI",
                    help="testing: pretend FIELD's packed lane tops out "
@@ -397,6 +452,8 @@ def main(argv=None):
             trace_out=resolve(args.trace_out, "TRACE_OUT", None),
             profile_chunks_every=resolve(args.profile_chunks,
                                          "PROFILE_CHUNKS", None),
+            por=bool(resolve(args.por or None, "POR", False)),
+            por_table=resolve(args.por_table, "POR_TABLE", None),
             degrade_on_oom=not args.no_degrade,
             progress_interval_seconds=float(
                 resolve(args.progress_interval, "PROGRESS_SECONDS", 60.0)))

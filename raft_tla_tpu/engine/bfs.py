@@ -117,6 +117,17 @@ class EngineConfig:
     # (is_new contract matches); switchable until a TPU profile decides
     # the fused-chunk question (NORTHSTAR.md §d).  Single-host engine only.
     insert_method: str = "xla"
+    # Statically-certified partial-order reduction (analysis/por.py).
+    # ``por=True`` certifies in-process at engine construction (traces
+    # the kernels once, proving the ample certificates against THIS
+    # run's invariants + constraint); ``por_table`` supplies a
+    # pre-certified table instead — a PorTable object or a path to the
+    # versioned artifact `analyze --passes por --por-artifact` writes.
+    # Every table is admission-checked (fingerprint, model signature,
+    # predicate coverage) before the mask is applied; a hand-edited or
+    # mismatched certificate raises instead of silently reducing.
+    por: bool = False
+    por_table: Optional[object] = None
     # None = defer to the cfg file (make_engine fills it in); a bool from
     # the caller always wins — the documented precedence chain.
     check_deadlock: Optional[bool] = None
@@ -237,6 +248,9 @@ class EngineResult:
     # Which successor pipeline actually ran ("v1"/"v2") — makes an
     # ``auto`` fallback observable instead of a silent slowdown.
     pipeline: str = ""
+    # Certified ample instances the run's POR table carried (0 = POR off
+    # or an all-conservative certificate — either way, full expansion).
+    por_instances: int = 0
     # Host-side per-phase wall-time breakdown for this run
     # ({phase: seconds}; obs/metrics.py phase timers): chunk dispatch,
     # stats fetch, trace flush, spill, fpset growth, checkpoint, ... —
@@ -361,6 +375,43 @@ def _resolve_insert(requested: str):
     raise ValueError(f"insert_method must be xla/pallas, got {requested!r}")
 
 
+def resolve_por(cfg: EngineConfig, dims, invariants, constraint):
+    """EngineConfig.por/por_table -> a verified analysis.por.PorTable or
+    None (POR off).  Shared by the single-chip and mesh engines.
+
+    A path loads the versioned artifact (fingerprint-checked — a
+    hand-edited mask is rejected there); ``por=True`` without a table
+    certifies in-process against exactly this run's invariants and
+    constraint.  Either way ``check_table`` gates admission: model
+    signature, instance count, and predicate coverage must match the
+    run, so a certificate can never be applied outside the conditions
+    it was proved under."""
+    if not cfg.por and cfg.por_table is None:
+        return None
+    from ..analysis import por as por_mod
+    table = cfg.por_table
+    if isinstance(table, str):
+        table = por_mod.load_table(table)
+    if table is None:
+        table = por_mod.build_table(dims, invariants=dict(invariants),
+                                    constraint=constraint)
+    por_mod.check_table(table, dims,
+                        invariant_names=list(invariants),
+                        has_constraint=constraint is not None)
+    return table
+
+
+def por_device_arrays(table):
+    """(mask, priority) jnp arrays for a verified table, or (None, None)
+    when there is nothing to mask — an all-conservative certificate
+    (certified == 0) compiles the EXACT pre-POR chunk program, paying
+    zero hot-path arithmetic for a mask that provably changes nothing.
+    Shared by both engines so the fast-path rule can never drift."""
+    if table is None or not table.certified:
+        return None, None
+    return jnp.asarray(table.ample_mask), jnp.asarray(table.priority)
+
+
 def _resolve_pipeline(requested: str, dims):
     """EngineConfig.pipeline -> a v2 pipeline object or None (v1).
 
@@ -458,6 +509,17 @@ class BFSEngine:
         pack_ok = build_pack_guard(dims)
         self._v2 = _resolve_pipeline(cfg.pipeline, dims)
         insert_fn = _resolve_insert(cfg.insert_method)
+        # Partial-order reduction table (analysis/por.py): verified
+        # before any mask is applied; None = full expansion.  Survives
+        # the re-entrant OOM-degrade __init__ (same rule as the registry
+        # above): the verified table is batch-independent, and
+        # re-resolving mid-degrade would re-trace every kernel — or
+        # re-read an artifact file that may be gone — exactly while the
+        # process is under memory pressure.
+        if not hasattr(self, "_por_table"):
+            self._por_table = resolve_por(
+                cfg, dims, dict(zip(self.inv_names, inv_fns)), constraint)
+        por_mask, por_priority = por_device_arrays(self._por_table)
         sw = state_width(dims)
         B, G = cfg.batch, dims.n_instances
         # Compacted-candidate lanes (ops/compact.py owns the invariants).
@@ -583,7 +645,8 @@ class BFSEngine:
             pack_ok=pack_ok, inv_fns=inv_fns, constraint=constraint,
             B=B, G=G, K=K, Q=Q, TQ=TQ, record_static=record_static,
             compactor=compactor, insert_fn=insert_fn, v2=self._v2,
-            enqueue_method=cfg.enqueue_method)
+            enqueue_method=cfg.enqueue_method,
+            por_mask=por_mask, por_priority=por_priority)
 
         def chunk(qcur, cur_count, offset0, qnext, next_count, seen,
                   tbuf, tcount0, max_steps):
@@ -599,12 +662,13 @@ class BFSEngine:
                     jnp.uint32(0), jnp.uint32(0), jnp.bool_(False),
                     jnp.zeros((len(dims.family_sizes),), _I32),
                     jnp.zeros((len(dims.family_sizes),), _I32),
-                    jnp.int32(0))
+                    jnp.int32(0),
+                    jnp.zeros((len(dims.family_sizes),), _I32))
 
             def cond(c):
                 (offset, steps, _qn, next_count, seen_c, _tb, tcount,
                  _g, _n, ovfc, dead_any, _dr, viol_any, _vi, _vr, _vh,
-                 _vl, fail_any, _fam, _famn, _exp) = c
+                 _vl, fail_any, _fam, _famn, _exp, _famp) = c
                 more = (offset < cur_count) & (steps < max_steps)
                 qroom = next_count <= QTH       # host spills past this
                 # Stop for growth at half-full: the host doubles the table
@@ -624,16 +688,19 @@ class BFSEngine:
                 cond, lambda c: chunk_body(qcur, cur_count, c), init)
             (offset, steps, qnext, next_count, seen, tbuf, tcount,
              gen, newc, ovfc, dead_any, drow, viol_any, vinv, vrow,
-             vhi, vlo, fail_any, fam_counts, fam_new, expanded) = out
-            # fam_counts/fam_new/expanded ride in the SAME packed vector
-            # — the loop's one-fetch-per-call contract is load-bearing
-            # over the tunnel.  Layout: 13 scalars, then the per-family
-            # generated counts, then the per-family novel counts
+             vhi, vlo, fail_any, fam_counts, fam_new, expanded,
+             fam_pruned) = out
+            # fam_counts/fam_new/expanded/fam_pruned ride in the SAME
+            # packed vector — the loop's one-fetch-per-call contract is
+            # load-bearing over the tunnel.  Layout: 13 scalars, then
+            # the per-family generated counts, then the per-family novel
+            # counts, then the per-family POR-pruned counts
             # (obs/coverage.py reads the host side).
             stats = jnp.concatenate([jnp.stack([
                 offset, steps, next_count, seen.size, tcount, gen, newc,
                 ovfc, dead_any.astype(_I32), viol_any.astype(_I32), vinv,
-                fail_any.astype(_I32), expanded]), fam_counts, fam_new])
+                fail_any.astype(_I32), expanded]), fam_counts, fam_new,
+                fam_pruned])
             return (qnext, seen, tbuf, stats, drow, vrow,
                     jnp.stack([vhi, vlo]))
 
@@ -872,7 +939,10 @@ class BFSEngine:
                     f"checkpoint dims {resume.dims} != engine dims {dims}")
         elif init_states is None:
             raise ValueError("need init_states or resume")
-        res = EngineResult(pipeline="v2" if self._v2 is not None else "v1")
+        res = EngineResult(
+            pipeline="v2" if self._v2 is not None else "v1",
+            por_instances=(self._por_table.certified
+                           if self._por_table is not None else 0))
         self._cur_res = res     # run_end event reads it on error exits
         mt, evlog = self.metrics, self._evlog
         self._growth_stalls = res.growth_stalls
@@ -1271,9 +1341,10 @@ class BFSEngine:
                                 res.action_counts.get(name, 0) + int(c))
                     # TLC-style coverage (obs/coverage.py): same packed
                     # stats, attributed per family — generated/distinct/
-                    # disabled all derive from this one fetch.
+                    # disabled/pruned all derive from this one fetch.
                     coverage.add_chunk(int(st[12]), st[13:13 + F],
-                                       st[13 + F:13 + 2 * F])
+                                       st[13 + F:13 + 2 * F],
+                                       st[13 + 2 * F:13 + 3 * F])
                     if cfg.record_trace and tcount:
                         with mt.phase_timer("trace_flush"):
                             self._flush_trace(trace, tbuf, tcount)

@@ -14,19 +14,19 @@ from typing import Callable, Dict, List, Optional
 
 from ..models import smoke
 from ..models.dims import RaftDims
-from ..models.invariants import Bounds, build_constraint, build_type_ok
-from ..models.safety import SAFETY_INVARIANTS
+from ..models.invariants import (Bounds, build_constraint,
+                                 invariant_registry)
 from ..models.pystate import PyState, init_state
 from ..utils.cfg import CheckSetup, load_config
 from .bfs import BFSEngine, EngineConfig, EngineResult
 
 # name -> builder(dims) -> kernel(state)->bool.  TypeOK (raft.tla:482-492)
 # plus the whole dead-region safety suite (raft.tla:896-1180; SURVEY §2.3),
-# checkable by naming them as INVARIANT in any cfg.
-INVARIANT_REGISTRY: Dict[str, Callable[[RaftDims], Callable]] = {
-    "TypeOK": build_type_ok,
-    **SAFETY_INVARIANTS,
-}
+# checkable by naming them as INVARIANT in any cfg.  The registry itself
+# lives in models/invariants.py (invariant_registry) so the analyzer's
+# POR visibility condition and this cfg resolution can never drift.
+INVARIANT_REGISTRY: Dict[str, Callable[[RaftDims], Callable]] = \
+    invariant_registry()
 
 CONSTRAINT_REGISTRY: Dict[str, Callable[[RaftDims, Bounds], Callable]] = {
     "BoundedSpace": build_constraint,
@@ -78,7 +78,9 @@ def engine_config_from_backend(setup: CheckSetup) -> EngineConfig:
         trace_dir=be.get("TRACE_DIR"),
         events_out=be.get("EVENTS_OUT"),
         trace_out=be.get("TRACE_OUT"),
-        profile_chunks_every=be.get("PROFILE_CHUNKS"))
+        profile_chunks_every=be.get("PROFILE_CHUNKS"),
+        por=bool(be.get("POR", False)),
+        por_table=be.get("POR_TABLE"))
 
 
 def make_engine(setup: CheckSetup,

@@ -17,20 +17,23 @@ bit-identical per batch (same candidate order, same compaction, same
 trace layout) for checkpoints to be portable across engines and for the
 differential tests to mean anything.
 
-The carry tuple layout (21 fields) is:
+The carry tuple layout (22 fields) is:
     (offset, steps, qnext, next_count, seen, tbuf, tcount,
      gen, newc, ovfc, dead_any, drow, viol_any, vinv, vrow, vhi, vlo,
-     fail_any, fam_counts, fam_new, expanded)
+     fail_any, fam_counts, fam_new, expanded, fam_pruned)
 
 ``fam_counts`` [n_families] accumulates enabled-successor counts per
 action family (TLC's per-action statistics; SURVEY §5.1) — a handful of
 static-slice reduces per batch.  ``fam_new`` [n_families] accumulates
 per-family NOVEL-state counts (the insert's novelty mask attributed to
-the compacted lane's action family — TLC coverage's "distinct"), and
+the compacted lane's action family — TLC coverage's "distinct"),
 ``expanded`` counts parents actually advanced past (valid, inside the
 taken prefix) — the exact base for host-side disabled-guard counts
-(``expanded * family_size - generated``).  All ride the same packed
-stats vector; obs/coverage.py is the host-side consumer.
+(``expanded * family_size - generated - pruned``) — and ``fam_pruned``
+counts enabled lanes the partial-order reduction masked out before
+fingerprinting (zero with POR off; the reduced-vs-full accounting
+obs/coverage.py renders).  All ride the same packed stats vector;
+obs/coverage.py is the host-side consumer.
 """
 
 from __future__ import annotations
@@ -46,7 +49,8 @@ _I32 = jnp.int32
 
 def build_chunk_body(*, dims, expand, fingerprint, pack_ok, inv_fns,
                      constraint, B, G, K, Q, TQ, record_static, compactor,
-                     insert_fn, v2=None, enqueue_method="scatter"):
+                     insert_fn, v2=None, enqueue_method="scatter",
+                     por_mask=None, por_priority=None):
     """Returns ``chunk_body(qcur, cur_count, carry) -> carry'``.
 
     ``Q`` is the live next-queue capacity (per chip for the mesh); masked
@@ -60,9 +64,22 @@ def build_chunk_body(*, dims, expand, fingerprint, pack_ok, inv_fns,
     only.  Bit-identical to the v1 path in every carry field (enabled/
     overflow masks, fingerprints, successor rows, per-family stats) —
     property-tested in tests/test_actions2.py — so the two paths share
-    checkpoints and differential baselines freely."""
+    checkpoints and differential baselines freely.
+
+    ``por_mask``/``por_priority`` ([G] bool / [G] int32 device arrays,
+    or both None = off) enable the statically-certified partial-order
+    reduction (analysis/por.py): when a state's enabled set contains a
+    certified ample instance, every OTHER expansion of that state is
+    masked out before fingerprinting — the lowest-priority-value
+    certified enabled lane is the one kept.  Deadlock detection is
+    unaffected (masking only fires on non-empty enabled sets), and
+    masked lanes' overflow flags are dropped with them (a pruned
+    successor is never materialized, so its capacity overflow cannot
+    abort the reduced run)."""
     if enqueue_method not in ("scatter", "window", "pallas"):
         raise ValueError(f"unknown enqueue method {enqueue_method!r}")
+    if (por_mask is None) != (por_priority is None):
+        raise ValueError("por_mask and por_priority must be given together")
     BG = B * G
     inv_id = build_inv_id(inv_fns) if inv_fns else None
 
@@ -71,7 +88,8 @@ def build_chunk_body(*, dims, expand, fingerprint, pack_ok, inv_fns,
     def chunk_body(qcur, cur_count, carry):
         (offset, steps, qnext, next_count, seen, tbuf, tcount,
          gen, newc, ovfc, dead_any, drow, viol_any, vinv, vrow,
-         vhi, vlo, fail_any, fam_counts, fam_new, expanded) = carry
+         vhi, vlo, fail_any, fam_counts, fam_new, expanded,
+         fam_pruned) = carry
         rows = jax.lax.dynamic_slice_in_dim(qcur, offset, B, axis=0)
         valid = (offset + jnp.arange(B, dtype=_I32)) < cur_count
         states = jax.vmap(unflatten_state, (0, None))(rows, dims)
@@ -87,6 +105,28 @@ def build_chunk_body(*, dims, expand, fingerprint, pack_ok, inv_fns,
             en, ovf = jax.vmap(v2.masks)(states)
             en = en & valid[:, None]
             ovf = ovf & valid[:, None]
+
+        if por_mask is not None:
+            # Partial-order reduction (analysis/por.py table): keep ONE
+            # certified ample lane per state that has any, masking its
+            # siblings before compaction/fingerprinting — the reduction
+            # the coverage tables account as "pruned".  Rows with no
+            # certified enabled instance are untouched, so a state with
+            # an empty enabled set still reads as a deadlock.
+            amp = en & por_mask[None, :]
+            any_amp = jnp.any(amp, axis=1)
+            pri = jnp.where(amp, por_priority[None, :],
+                            jnp.int32(2147483647))
+            sel = jnp.argmin(pri, axis=1)
+            keep = jnp.where(
+                any_amp[:, None],
+                jnp.arange(G, dtype=_I32)[None, :] == sel[:, None],
+                jnp.ones((B, G), bool))
+            pruned = en & ~keep
+            en = en & keep
+            ovf = ovf & keep
+        else:
+            pruned = None
 
         # Progress limiting + lane compaction (ops/compact.py): take the
         # longest parent prefix whose fan-out fits K, compact the enabled
@@ -219,12 +259,21 @@ def build_chunk_body(*, dims, expand, fingerprint, pack_ok, inv_fns,
             [jnp.sum(new & (kact >= off) & (kact < off + sz), dtype=_I32)
              for off, sz in fam_slices])
         expanded = expanded + jnp.sum(valid & ptaken, dtype=_I32)
+        if pruned is not None:
+            # Reduced-vs-full accounting (obs/coverage.py): enabled lanes
+            # the POR mask dropped, counted only for parents this step
+            # actually advanced past (same base as ``expanded``).
+            ptr = pruned & ptaken[:, None]
+            fam_pruned = fam_pruned + jnp.stack(
+                [jnp.sum(ptr[:, off:off + sz], dtype=_I32)
+                 for off, sz in fam_slices])
         return (offset + P, steps + 1, qnext, next_count, seen, tbuf,
                 tcount, gen + total,
                 newc + jnp.sum(new, dtype=_I32),
                 ovfc + jnp.sum(ovf, dtype=_I32),
                 dead_any | dead_any_b, drow,
                 viol_any | viol_any_b, vinv, vrow, vhi, vlo,
-                fail_any | fail, fam_counts, fam_new, expanded)
+                fail_any | fail, fam_counts, fam_new, expanded,
+                fam_pruned)
 
     return chunk_body

@@ -137,6 +137,59 @@ def build_constraint(dims: RaftDims, bounds: Bounds):
     return constraint
 
 
+#: Reserved predicate name for the cfg CONSTRAINT in read-set exports and
+#: POR certificates (a cfg names its constraint operator, e.g.
+#: ``BoundedSpace``, but the certificate cares about the *predicate the
+#: engine actually evaluates*, so one canonical name covers it).
+CONSTRAINT_PREDICATE = "CONSTRAINT"
+
+
+def invariant_registry():
+    """THE name -> builder registry of checkable invariants: TypeOK plus
+    the models/safety.py suite.  Single source of truth — both
+    ``engine/check.py``'s cfg resolution and the POR pass's visibility
+    condition read this, so a new invariant registers once and is
+    immediately nameable in cfgs AND part of the analyzer's conservative
+    default predicate set.  (A function, not a constant: safety.py is
+    imported lazily to keep this module import-light.)"""
+    from .safety import SAFETY_INVARIANTS
+    return {"TypeOK": build_type_ok, **SAFETY_INVARIANTS}
+
+
+def checkable_predicates(dims: RaftDims, invariant_names=None,
+                         bounds: Optional[Bounds] = None,
+                         constraint=None):
+    """Every state predicate a check run can evaluate, as
+    ``[(name, kernel)]`` — the machine-readable export the POR pass's
+    invariant-visibility condition traces read sets from (analysis/por.py).
+
+    ``invariant_names=None`` returns the CONSERVATIVE default: TypeOK plus
+    the full safety suite (models/safety.py) — a certificate proved
+    against every registered predicate stays valid for any cfg that
+    checks a subset of them.  Passing the cfg's INVARIANT list narrows
+    the set (and therefore the visibility condition) to what that model
+    actually checks.  The CONSTRAINT predicate is appended (under
+    :data:`CONSTRAINT_PREDICATE`) when ``constraint`` is given or
+    ``bounds`` carries any bound: constraint reads gate *expansion*, so
+    POR must treat them exactly like invariant reads."""
+    registry = invariant_registry()
+    names = (list(registry) if invariant_names is None
+             else list(invariant_names))
+    out = []
+    for name in names:
+        if name not in registry:
+            raise ValueError(f"unknown invariant {name!r}; registered: "
+                             f"{sorted(registry)}")
+        out.append((name, registry[name](dims)))
+    if constraint is not None:
+        out.append((CONSTRAINT_PREDICATE, constraint))
+    elif bounds is not None and any(
+            getattr(bounds, f.name) is not None
+            for f in dataclasses.fields(bounds)):
+        out.append((CONSTRAINT_PREDICATE, build_constraint(dims, bounds)))
+    return out
+
+
 def constraint_py(bounds: Bounds):
     def constraint(s: PyState, dims: RaftDims) -> bool:
         ok = True

@@ -40,15 +40,22 @@ class ActionCoverage:
         self.sizes: List[int] = [int(s) for s in family_sizes]
         self.generated: Dict[str, int] = {n: 0 for n in self.names}
         self.distinct: Dict[str, int] = {n: 0 for n in self.names}
+        #: Enabled lanes the partial-order reduction masked out before
+        #: fingerprinting (analysis/por.py; zero with POR off) — the
+        #: reduced-vs-full accounting: a pruned guard evaluation was
+        #: TRUE, so it belongs to neither ``generated`` nor
+        #: ``disabled``.
+        self.pruned: Dict[str, int] = {n: 0 for n in self.names}
         #: Parents actually expanded (each evaluates every instance's
         #: guard once) — the base for the disabled counts.
         self.expanded = 0
 
-    def add_chunk(self, expanded: int, gen_counts, new_counts) -> None:
+    def add_chunk(self, expanded: int, gen_counts, new_counts,
+                  pruned_counts=None) -> None:
         """Fold one chunk call's packed per-family stats in.
-        ``gen_counts``/``new_counts`` are the per-family vectors from the
-        chunk stats (any int sequence), ``expanded`` the parents the
-        call advanced past."""
+        ``gen_counts``/``new_counts``/``pruned_counts`` are the
+        per-family vectors from the chunk stats (any int sequence),
+        ``expanded`` the parents the call advanced past."""
         self.expanded += int(expanded)
         for name, g, d in zip(self.names, gen_counts, new_counts):
             g, d = int(g), int(d)
@@ -56,6 +63,11 @@ class ActionCoverage:
                 self.generated[name] += g
             if d:
                 self.distinct[name] += d
+        if pruned_counts is not None:
+            for name, p in zip(self.names, pruned_counts):
+                p = int(p)
+                if p:
+                    self.pruned[name] += p
 
     def seed_generated(self, action_counts: Dict[str, int]) -> None:
         """Resume support: continue the generated series from a
@@ -71,8 +83,10 @@ class ActionCoverage:
         size = self.sizes[self.names.index(name)]
         # Clamped: a resumed run's expanded counter restarts at zero
         # while generated resumes from the checkpoint, which would
-        # otherwise push this negative.
-        return max(0, self.expanded * size - self.generated[name])
+        # otherwise push this negative.  Pruned lanes had a TRUE guard,
+        # so they are subtracted from the disabled base too.
+        return max(0, self.expanded * size - self.generated[name]
+                   - self.pruned[name])
 
     @property
     def total_generated(self) -> int:
@@ -82,12 +96,18 @@ class ActionCoverage:
     def total_distinct(self) -> int:
         return sum(self.distinct.values())
 
+    @property
+    def total_pruned(self) -> int:
+        return sum(self.pruned.values())
+
     def snapshot(self) -> Dict[str, Dict[str, int]]:
-        """JSON-ready ``{family: {generated, distinct, disabled}}`` — the
-        payload of ``coverage`` events and bench JSON's ``coverage``."""
+        """JSON-ready ``{family: {generated, distinct, disabled,
+        pruned}}`` — the payload of ``coverage`` events and bench JSON's
+        ``coverage``."""
         return {n: {"generated": self.generated[n],
                     "distinct": self.distinct[n],
-                    "disabled": self.disabled(n)}
+                    "disabled": self.disabled(n),
+                    "pruned": self.pruned[n]}
                 for n in self.names}
 
     def feed_metrics(self, metrics) -> None:
@@ -98,23 +118,33 @@ class ActionCoverage:
             metrics.gauge(f"coverage/{n}/generated", self.generated[n])
             metrics.gauge(f"coverage/{n}/distinct", self.distinct[n])
             metrics.gauge(f"coverage/{n}/disabled", self.disabled(n))
+            metrics.gauge(f"coverage/{n}/pruned", self.pruned[n])
         metrics.gauge("coverage/expanded_states", self.expanded)
 
     def render_table(self) -> str:
         """The TLC-parity run-end report (stderr): one row per action
         family, sorted by generated, with the distinct ratio that tells
-        a user which actions are churning duplicates."""
+        a user which actions are churning duplicates.  A ``pruned``
+        column appears only when the run's POR mask dropped anything, so
+        full-expansion renders are byte-identical to the pre-POR
+        format."""
         rows = sorted(self.names, key=lambda n: -self.generated[n])
         width = max([len(n) for n in self.names] + [6])
+        por = self.total_pruned > 0
+        prun_hdr = f" {'pruned':>12s}" if por else ""
         lines = [f"coverage (actions: {len(self.names)}, parents "
-                 f"expanded: {self.expanded:,}):",
+                 f"expanded: {self.expanded:,}"
+                 + (f", POR pruned: {self.total_pruned:,}" if por else "")
+                 + "):",
                  f"  {'action':{width}s} {'generated':>12s} "
-                 f"{'distinct':>12s} {'disabled':>14s} {'new%':>6s}"]
+                 f"{'distinct':>12s} {'disabled':>14s}{prun_hdr} "
+                 f"{'new%':>6s}"]
         for n in rows:
             g, d = self.generated[n], self.distinct[n]
             pct = f"{100.0 * d / g:5.1f}%" if g else "    --"
+            prun = f" {self.pruned[n]:12,d}" if por else ""
             lines.append(f"  {n:{width}s} {g:12,d} {d:12,d} "
-                         f"{self.disabled(n):14,d} {pct:>6s}")
+                         f"{self.disabled(n):14,d}{prun} {pct:>6s}")
         lines.append(f"  {'total':{width}s} {self.total_generated:12,d} "
                      f"{self.total_distinct:12,d}")
         return "\n".join(lines)

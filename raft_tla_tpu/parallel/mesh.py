@@ -134,8 +134,19 @@ class MeshBFSEngine:
         expand = build_expand(dims)
         fingerprint = build_fingerprint(dims)
         pack_ok = build_pack_guard(dims)
-        from ..engine.bfs import _resolve_pipeline
+        from ..engine.bfs import (_resolve_pipeline, por_device_arrays,
+                                  resolve_por)
         self._v2 = _resolve_pipeline(cfg.pipeline, dims)
+        # POR reduction table (analysis/por.py): resolved/verified once
+        # on the host; the [G] mask/priority arrays are closed over by
+        # the chunk body below, so shard_map replicates them to every
+        # chip (the mask broadcast) — each chip applies the identical
+        # reduction, keeping the engines' bit-identical-per-batch
+        # contract intact.
+        if not hasattr(self, "_por_table"):   # growth-path re-init reuses
+            self._por_table = resolve_por(
+                cfg, dims, dict(zip(self.inv_names, inv_fns)), constraint)
+        por_mask, por_priority = por_device_arrays(self._por_table)
         sw = state_width(dims)
         B, G = cfg.batch, dims.n_instances
         # Compacted-candidate lanes per chip (ops/compact.py): only K
@@ -267,7 +278,8 @@ class MeshBFSEngine:
             pack_ok=pack_ok, inv_fns=inv_fns, constraint=constraint,
             B=B, G=G, K=K, Q=QL, TQ=TQ, record_static=record_static,
             compactor=compactor, insert_fn=route_insert, v2=self._v2,
-            enqueue_method=cfg.enqueue_method)
+            enqueue_method=cfg.enqueue_method,
+            por_mask=por_mask, por_priority=por_priority)
 
         def sharded_chunk(qcur, cur_counts, offset0, qnext, next_counts,
                           shi, slo, ssize, tbuf, tcount0, max_steps):
@@ -288,12 +300,13 @@ class MeshBFSEngine:
                     jnp.uint32(0), jnp.uint32(0), jnp.bool_(False),
                     jnp.zeros((len(dims.family_sizes),), _I32),
                     jnp.zeros((len(dims.family_sizes),), _I32),
-                    jnp.int32(0))
+                    jnp.int32(0),
+                    jnp.zeros((len(dims.family_sizes),), _I32))
 
             def cond(c):
                 (offset, steps, _qn, ncnt_c, seen_c, _tb, tcnt_c,
                  _g, _n, ovfc, dead_any, _dr, viol_any, _vi, _vr, _vh,
-                 _vl, fail_any, _fam, _famn, _exp) = c
+                 _vl, fail_any, _fam, _famn, _exp, _famp) = c
                 # Every term is reduced to a REPLICATED bool so all chips
                 # take the same trip count (the body contains all_to_all).
                 more = (offset < max_count) & (steps < max_steps)
@@ -311,7 +324,8 @@ class MeshBFSEngine:
                 cond, lambda c: chunk_body(qcur_l, cnt_l, c), init)
             (offset, steps, qnext_l, ncnt_l, seen_l, tbuf_l, tcnt_l,
              gen, newc, ovfc, dead_any, drow, viol_any, vinv, vrow,
-             vhi, vlo, fail_any, fam_counts, fam_new, expanded) = out
+             vhi, vlo, fail_any, fam_counts, fam_new, expanded,
+             fam_pruned) = out
             g_gen = jax.lax.psum(gen, "x")
             g_new = jax.lax.psum(newc, "x")
             g_ovf = jax.lax.psum(ovfc, "x")
@@ -341,7 +355,8 @@ class MeshBFSEngine:
                            jax.lax.psum(cnt_l, "x"),
                            jax.lax.psum(expanded, "x")]),
                 jax.lax.psum(fam_counts, "x"),
-                jax.lax.psum(fam_new, "x")])
+                jax.lax.psum(fam_new, "x"),
+                jax.lax.psum(fam_pruned, "x")])
             vfp_g = jnp.stack([vhi_g, vlo_g])
             return (qnext_l[None], ncnt_l[None], seen_l.hi[None],
                     seen_l.lo[None], seen_l.size[None],
@@ -590,7 +605,10 @@ class MeshBFSEngine:
             # within a ~24-day wrap, and only in a REUSED directory.
             self._trace_run_id = mh.build_min(self.mesh)(
                 int(time.time() * 1000) & 0x7FFFFFFF)
-        res = EngineResult(pipeline="v2" if self._v2 is not None else "v1")
+        res = EngineResult(
+            pipeline="v2" if self._v2 is not None else "v1",
+            por_instances=(self._por_table.certified
+                           if self._por_table is not None else 0))
         self._cur_res = res     # run_end event reads it on error exits
         mt, evlog = self.metrics, self._evlog
         self._growth_stalls = res.growth_stalls
@@ -969,7 +987,8 @@ class MeshBFSEngine:
                     # Coverage from the same psum'd packed stats
                     # (obs/coverage.py; engine/bfs.py rationale).
                     coverage.add_chunk(int(st[15]), st[16:16 + F],
-                                       st[16 + F:16 + 2 * F])
+                                       st[16 + F:16 + 2 * F],
+                                       st[16 + 2 * F:16 + 3 * F])
                     if int(st[4]):
                         raise RuntimeError(
                             f"{int(st[4])} successors exceeded fixed-width "

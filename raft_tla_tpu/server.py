@@ -170,13 +170,28 @@ def _do_check(req):
         max_seconds=req.get("max_seconds"),
         max_diameter=req.get("max_diameter"),
         record_trace=record_trace,
-        check_deadlock=req.get("check_deadlock"))
-    # check_deadlock is baked into the compiled program, so it keys the
-    # cache; the StopAfter budgets are host-side and are refreshed on the
-    # cached engine's config below.
+        check_deadlock=req.get("check_deadlock"),
+        por=(bool(req["por"]) if req.get("por") is not None
+             else base.por),
+        por_table=(req["por_table"] if req.get("por_table") is not None
+                   else base.por_table))
+    # check_deadlock (and the POR mask) are baked into the compiled
+    # program, so they key the cache; the StopAfter budgets are
+    # host-side and are refreshed on the cached engine's config below.
+    # A table artifact keys by CONTENT, not path (the same file-identity
+    # rule as ``ident``): regenerating the artifact in place must build
+    # a fresh engine, not keep serving the stale mask.
+    por_key = None
+    if cfg.por_table is not None:
+        if isinstance(cfg.por_table, str):
+            import hashlib
+            with open(cfg.por_table, "rb") as f:
+                por_key = hashlib.sha256(f.read()).hexdigest()
+        else:
+            por_key = cfg.por_table.fingerprint
     key = (ident, req.get("engine", "single"), cfg.batch,
            cfg.queue_capacity, cfg.seen_capacity, record_trace,
-           cfg.check_deadlock)
+           cfg.check_deadlock, cfg.por, por_key)
     engine = _cache_get(_ENGINES, key, "engine_cache")
     if engine is None:
         engine_cls = None

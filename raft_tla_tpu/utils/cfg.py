@@ -32,7 +32,8 @@ Recognized keys: BATCH, QUEUE_CAPACITY, SEEN_CAPACITY, N_MSG_SLOTS,
 MAX_LOG, PLATFORM, CHECKPOINT_DIR, CHECKPOINT_EVERY, CHECKPOINT_INTERVAL,
 SPILL_DIR, TRACE_DIR, PROGRESS_SECONDS, EVENTS_OUT, KEEP_CHECKPOINTS,
 TRACE_OUT (Chrome-trace span file), PROFILE_CHUNKS (per-stage chunk
-profiling cadence).
+profiling cadence), POR (statically-certified partial-order reduction),
+POR_TABLE (pre-certified reduction-table artifact path).
 Precedence everywhere: CLI flag > cfg backend key > built-in default.
 """
 
@@ -81,7 +82,7 @@ _BACKEND_KEYS = {
     "BATCH", "QUEUE_CAPACITY", "SEEN_CAPACITY", "N_MSG_SLOTS", "MAX_LOG",
     "PLATFORM", "CHECKPOINT_DIR", "CHECKPOINT_EVERY", "CHECKPOINT_INTERVAL",
     "SPILL_DIR", "TRACE_DIR", "PROGRESS_SECONDS", "EVENTS_OUT",
-    "KEEP_CHECKPOINTS", "TRACE_OUT", "PROFILE_CHUNKS",
+    "KEEP_CHECKPOINTS", "TRACE_OUT", "PROFILE_CHUNKS", "POR", "POR_TABLE",
 }
 
 
@@ -98,8 +99,11 @@ def parse_backend_directives(text: str) -> Dict[str, object]:
             out[key] = int(raw)
         elif re.fullmatch(r"-?\d+\.\d*", raw):
             out[key] = float(raw)
-        elif raw in ("TRUE", "FALSE"):
-            out[key] = raw == "TRUE"
+        elif raw.upper() in ("TRUE", "FALSE"):
+            # Case-insensitive like the keys: boolean directives (POR)
+            # must not silently truthy-string their way to enabled when
+            # written ``= false``.
+            out[key] = raw.upper() == "TRUE"
         else:
             out[key] = raw
     return out

@@ -168,6 +168,15 @@ def diff_coverage(old: dict, new: dict, d: Diff, drift_pts: float):
           else new.get("generated_by_action") or {})
     if not og or not ng:
         return
+    # POR reduced-vs-full accounting: report the generated-state
+    # reduction whenever either side's coverage carries pruned lanes
+    # (the distinct/s regression gates above stay the arbiter — a
+    # reduction that does not pay off in rate still fails there).
+    op = sum(v.get("pruned", 0) for v in ocov.values()) if ocov else 0
+    np_ = sum(v.get("pruned", 0) for v in ncov.values()) if ncov else 0
+    if op or np_:
+        d.note(f"POR pruned expansions: {op:,} -> {np_:,} "
+               f"(generated {sum(og.values()):,} -> {sum(ng.values()):,})")
     ot, nt = sum(og.values()), sum(ng.values())
     if not ot or not nt:
         return

@@ -51,7 +51,11 @@ def pytest_collection_modifyitems(config, items):
     the trace-heavy module to the end keeps the heap history of every
     pre-existing test identical to what it was before analysis/ existed;
     the analysis tests themselves are trace-only and order-independent."""
-    analysis = [it for it in items if "test_analysis" in it.nodeid]
+    def heavy(it):
+        # test_por traces the same kernel set (plus every invariant
+        # predicate) through the analyzers — same churn, same slot.
+        return "test_analysis" in it.nodeid or "test_por" in it.nodeid
+
+    analysis = [it for it in items if heavy(it)]
     if analysis and len(analysis) < len(items):
-        items[:] = ([it for it in items if "test_analysis" not in it.nodeid]
-                    + analysis)
+        items[:] = [it for it in items if not heavy(it)] + analysis

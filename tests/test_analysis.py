@@ -187,6 +187,43 @@ def test_no_dead_lanes_on_base_model(effect_summary):
     assert all(v == 0 for v in dead.values()), dead
 
 
+def test_dependence_matrices_serialized_and_roundtrip(effect_summary):
+    """The analyze report carries the FULL per-instance matrices (hex
+    row bitmasks + labels) — the stable artifact POR and BLEST-style
+    batching consume instead of re-tracing — and the decoder inverts
+    the packing exactly."""
+    from raft_tla_tpu.analysis import effects
+    summary, _ = effect_summary
+    sj = effects.summary_json(summary)
+    G = sj["n_instances"]
+    assert len(sj["instances"]) == G
+    assert len(sj["independent_hex"]) == G
+    assert len(sj["guard_independent_hex"]) == G
+    json.dumps(sj)                      # report-serializable as-is
+    ind, gind = effects.matrices_from_json(sj)
+    assert (ind == summary.independent).all()
+    assert (gind == summary.guard_independent).all()
+
+
+def test_read_set_self_check_clean_and_planted(effect_summary):
+    """Analyzer-vs-analyzer consistency: every lane a kernel jaxpr
+    demonstrably reads is inside the effects pass's reported read set
+    (clean on the seed kernels); deleting a reported read makes the
+    check fire — the sensitivity proof."""
+    from raft_tla_tpu.analysis import lint
+    summary, _ = effect_summary
+    assert lint.read_set_check(DIMS, effect_summary=summary) == []
+    reads = {fam: d["reads"] | d["guard_reads"]
+             for fam, d in summary.families.items()}
+    reads["DuplicateMessage"] = reads["DuplicateMessage"] - {"msg_cnt"}
+    findings = lint.read_set_check(DIMS, family_reads=reads)
+    assert len(findings) == 1
+    f = findings[0]
+    assert f.severity == ERROR and f.code == "read-set-mismatch"
+    assert f.field == "DuplicateMessage"
+    assert f.details["extra_reads"] == ["msg_cnt"]
+
+
 # ---------------------------------------------------------------------------
 # bounds
 
