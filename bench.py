@@ -130,6 +130,13 @@ def main():
     # the coverage object's "pruned" column and the generated/distinct
     # headline — bench_diff.py then reports generated-state reduction
     # alongside the distinct/s regression gate.
+    # Successor pipeline (BENCH_PIPELINE=auto/v1/v2/v3): v3 is the fused
+    # Pallas chunk (ops/pipeline_v3.py) — on TPU the real fused kernels,
+    # off-TPU interpret mode for the Pallas stages the platform policy
+    # keeps (the CI v2-vs-v3 gate runs this on CPU with fold-to-common
+    # stages in bench_diff.py).  The run's resolved pipeline + per-stage
+    # plan are embedded in the JSON so two benches are always
+    # attributable.
     cfg = EngineConfig(
         batch=int(os.environ.get("BENCH_BATCH",
                                  str(2048 if on_accel else 512))),
@@ -141,6 +148,7 @@ def main():
         events_out=events_file,
         trace_out=os.environ.get("BENCH_TRACE_OUT"),
         profile_chunks_every=profile_every or None,
+        pipeline=os.environ.get("BENCH_PIPELINE", "auto"),
         por=bool(int(os.environ.get("BENCH_POR", "0"))),
         por_table=os.environ.get("BENCH_POR_TABLE"))
     # "auto": on a multi-accelerator slice (e.g. v5e-8) the run shards
@@ -233,6 +241,12 @@ def main():
         # BENCH_r* trajectories on.
         "chunk_stages": {k: round(v, 6)
                          for k, v in res.chunk_stages.items()},
+        # Which successor pipeline ran, and (v3) the per-stage lowering
+        # plan — bench_diff folds mismatched chunk_stages granularities
+        # across pipelines using this context.
+        "pipeline": res.pipeline,
+        "fused_stages": dict(res.fused_stages),
+        "fused_reasons": dict(res.fused_reasons),
         "coverage": res.coverage,
         # Certified ample instances the run's POR table carried (0 = POR
         # off or an all-conservative certificate).

@@ -161,6 +161,16 @@ def main(argv=None):
                         help="mesh = shard over all visible devices (TLC "
                              "-workers / distributed TLC analog); auto = "
                              "mesh iff >1 accelerator device (default)")
+        sp.add_argument("--pipeline", choices=("auto", "v1", "v2", "v3"),
+                        default=None,
+                        help="successor pipeline: v1 = classical expand, "
+                             "v2 = delta (guards-only masks + delta "
+                             "fingerprints), v3 = fused Pallas chunk "
+                             "(VMEM-resident compact + probe/insert->"
+                             "enqueue tail; per-stage XLA fallback, "
+                             "interpret mode off-TPU).  auto = v2 where "
+                             "it applies (default; flag > cfg PIPELINE "
+                             "directive > auto)")
 
     c = sub.add_parser("check", help="exhaustive BFS check")
     common(c)
@@ -452,6 +462,7 @@ def main(argv=None):
             trace_out=resolve(args.trace_out, "TRACE_OUT", None),
             profile_chunks_every=resolve(args.profile_chunks,
                                          "PROFILE_CHUNKS", None),
+            pipeline=resolve(args.pipeline, "PIPELINE", "auto"),
             por=bool(resolve(args.por or None, "POR", False)),
             por_table=resolve(args.por_table, "POR_TABLE", None),
             degrade_on_oom=not args.no_degrade,
@@ -526,7 +537,10 @@ def main(argv=None):
         from .engine.simulate import Simulator
     sim = Simulator(setup.dims, invariants=resolve_invariants(setup),
                     constraint=resolve_constraint(setup),
-                    batch=batch, depth=args.depth)
+                    batch=batch, depth=args.depth,
+                    # "v3" is a chunk-tail story; the simulator runs its
+                    # v2 (delta) semantics for it (same resolution rule).
+                    pipeline=resolve(args.pipeline, "PIPELINE", "auto"))
     # Span tracing (obs/tracing.py): attaching the tracer to the sim's
     # registry mirrors every sim_chunk/sim_fetch phase into the Chrome
     # trace; one top-level span brackets the whole simulation.

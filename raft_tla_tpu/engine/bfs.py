@@ -98,7 +98,18 @@ class EngineConfig:
     # construction; the TPU-profile-driven rework) wherever it applies
     # (base action alphabet), v1 expand for spec variants with
     # extra_families.  "v1"/"v2" force one path (v2 raises on variants).
+    # "v3" = v2 semantics with the chunk stages progressively fused into
+    # Pallas kernels (ops/pipeline_v3.py: Pallas compact scan + the
+    # fused probe/insert->enqueue tail, VMEM-resident survivor window;
+    # interpret mode off-TPU).  Bit-identical to v2 by contract; every
+    # stage that cannot lower falls back to its XLA lowering
+    # automatically, with the resolved per-stage plan recorded on
+    # ``EngineResult.fused_stages``.  Opt-in: "auto" never selects v3.
     pipeline: str = "auto"
+    # Per-stage override for the v3 plan ({"compact": "pallas"|"xla",
+    # "insert": "fused"|"xla", ...}) — tests force the full Pallas chain
+    # on CPU through this; None = the platform policy.
+    v3_force_stages: Optional[dict] = None
     # Lane-compaction lowering (ops/compact.py): "scatter" (original) or
     # "searchsorted" (binary-search inversion; identical outputs).  Kept
     # switchable until a TPU profile picks the winner.
@@ -245,9 +256,18 @@ class EngineResult:
     # duration clock, recorded as evidence for up-front SEEN_CAPACITY
     # sizing (each is a rehash + retrace on the growing engine).
     growth_stalls: List = dataclasses.field(default_factory=list)
-    # Which successor pipeline actually ran ("v1"/"v2") — makes an
+    # Which successor pipeline actually ran ("v1"/"v2"/"v3") — makes an
     # ``auto`` fallback observable instead of a silent slowdown.
     pipeline: str = ""
+    # v3 only: the resolved per-stage lowering plan ({stage: "xla"|
+    # "pallas"|"fused"}, ops/pipeline_v3.py) — a stage that fell back
+    # to XLA is visible here, never a silent degradation.  {} for v1/v2.
+    fused_stages: Dict[str, str] = dataclasses.field(default_factory=dict)
+    # ...and WHY each non-Pallas stage is what it is ({stage: reason}):
+    # distinguishes a policy choice / explicit force from a kernel that
+    # FAILED its build-probe ("... failed to build/probe: ...") — the
+    # operator-facing half of the no-silent-degradation contract.
+    fused_reasons: Dict[str, str] = dataclasses.field(default_factory=dict)
     # Certified ample instances the run's POR table carried (0 = POR off
     # or an all-conservative certificate — either way, full expansion).
     por_instances: int = 0
@@ -419,14 +439,20 @@ def _resolve_pipeline(requested: str, dims):
     variant genuinely lacks v2 kernels) selects v1 — any other error from
     kernel construction propagates, so a bug in a variant's
     ``build_extra_v2`` can never silently degrade to the slow path.  The
-    resolved choice is recorded on ``EngineResult.pipeline``."""
+    resolved choice is recorded on ``EngineResult.pipeline``.
+
+    "v3" shares v2's delta kernels (same semantics, hence the same
+    variant requirement and the same hard failure on one without v2
+    kernels); the fused-stage plan on top is the engines' business
+    (ops/pipeline_v3.py)."""
     from ..models.actions2 import V2Unavailable, build_v2
     if requested == "v1":
         return None
-    if requested == "v2":
+    if requested in ("v2", "v3"):
         return build_v2(dims)   # raises if a variant lacks v2 kernels
     if requested != "auto":
-        raise ValueError(f"pipeline must be auto/v1/v2, got {requested!r}")
+        raise ValueError(
+            f"pipeline must be auto/v1/v2/v3, got {requested!r}")
     try:
         return build_v2(dims)
     except V2Unavailable:
@@ -494,6 +520,12 @@ class BFSEngine:
                     min(cfg.seen_capacity or (1 << 20), 1 << 22),
                     8 * prof_k),
                 compact_method=cfg.compact_method,
+                # v3 runs are profiled at the fused-stage granularity
+                # (masks / compact / fingerprint / insert_enqueue);
+                # v1/v2 keep the classical decomposition so the
+                # NORTHSTAR budget rows stay comparable across PRs.
+                pipeline="v3" if cfg.pipeline == "v3" else "v1",
+                v3_force=cfg.v3_force_stages,
                 every=cfg.profile_chunks_every, metrics=self.metrics)
         else:
             self._profiler = None
@@ -637,6 +669,25 @@ class BFSEngine:
         self._QTH = QTH
         compactor = compact_mod.build_compactor(
             B, G, K, method=cfg.compact_method)
+        # v3: resolve the fused-stage plan (ops/pipeline_v3.py) — Pallas
+        # compact + the fused insert->enqueue tail where they lower,
+        # automatic per-stage XLA fallback (with recorded reasons)
+        # everywhere else.  The split stages below stay exactly the v2
+        # lowerings, so a fully-fallen-back v3 compiles the v2 program.
+        fused_tail = None
+        enqueue_method = cfg.enqueue_method
+        if cfg.pipeline == "v3":
+            from ..ops import pipeline_v3
+            self._v3_plan = pipeline_v3.resolve_plan(
+                B, G, K, Q=Q, sw=sw, mesh=False,
+                enqueue_method=cfg.enqueue_method,
+                force=cfg.v3_force_stages)
+            if self._v3_plan.compactor is not None:
+                compactor = self._v3_plan.compactor
+            fused_tail = self._v3_plan.tail
+            enqueue_method = self._v3_plan.enqueue_method
+        else:
+            self._v3_plan = None
 
         # The per-batch pipeline body is shared with the mesh engine
         # (engine/chunk.py) — only the insert function differs.
@@ -645,8 +696,9 @@ class BFSEngine:
             pack_ok=pack_ok, inv_fns=inv_fns, constraint=constraint,
             B=B, G=G, K=K, Q=Q, TQ=TQ, record_static=record_static,
             compactor=compactor, insert_fn=insert_fn, v2=self._v2,
-            enqueue_method=cfg.enqueue_method,
-            por_mask=por_mask, por_priority=por_priority)
+            enqueue_method=enqueue_method,
+            por_mask=por_mask, por_priority=por_priority,
+            fused_tail=fused_tail)
 
         def chunk(qcur, cur_count, offset0, qnext, next_count, seen,
                   tbuf, tcount0, max_steps):
@@ -940,7 +992,12 @@ class BFSEngine:
         elif init_states is None:
             raise ValueError("need init_states or resume")
         res = EngineResult(
-            pipeline="v2" if self._v2 is not None else "v1",
+            pipeline=("v3" if self._v3_plan is not None
+                      else "v2" if self._v2 is not None else "v1"),
+            fused_stages=(dict(self._v3_plan.stages)
+                          if self._v3_plan is not None else {}),
+            fused_reasons=(dict(self._v3_plan.reasons)
+                           if self._v3_plan is not None else {}),
             por_instances=(self._por_table.certified
                            if self._por_table is not None else 0))
         self._cur_res = res     # run_end event reads it on error exits

@@ -79,6 +79,7 @@ def engine_config_from_backend(setup: CheckSetup) -> EngineConfig:
         events_out=be.get("EVENTS_OUT"),
         trace_out=be.get("TRACE_OUT"),
         profile_chunks_every=be.get("PROFILE_CHUNKS"),
+        pipeline=be.get("PIPELINE", EngineConfig.pipeline),
         por=bool(be.get("POR", False)),
         por_table=be.get("POR_TABLE"))
 
@@ -193,6 +194,17 @@ def format_result(res: EngineResult) -> str:
         f"wall seconds       {res.wall_seconds:.2f}",
         f"states/sec         {res.states_per_second:.0f}",
     ]
+    if res.pipeline:
+        line = f"pipeline           {res.pipeline}"
+        if res.fused_stages:
+            line += " (" + " ".join(
+                f"{s}={impl}" for s, impl in res.fused_stages.items()) + ")"
+        lines.append(line)
+        # A stage that FAILED its build-probe (vs a policy/forced XLA
+        # choice) is operator-actionable — say so in the result block.
+        for s, why in sorted(res.fused_reasons.items()):
+            if "failed to build/probe" in why:
+                lines.append(f"  {s} fell back: {why}")
     if res.action_counts:
         lines.append("generated by action family:")
         for name, c in sorted(res.action_counts.items(),

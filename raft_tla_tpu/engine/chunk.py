@@ -50,7 +50,7 @@ _I32 = jnp.int32
 def build_chunk_body(*, dims, expand, fingerprint, pack_ok, inv_fns,
                      constraint, B, G, K, Q, TQ, record_static, compactor,
                      insert_fn, v2=None, enqueue_method="scatter",
-                     por_mask=None, por_priority=None):
+                     por_mask=None, por_priority=None, fused_tail=None):
     """Returns ``chunk_body(qcur, cur_count, carry) -> carry'``.
 
     ``Q`` is the live next-queue capacity (per chip for the mesh); masked
@@ -75,11 +75,22 @@ def build_chunk_body(*, dims, expand, fingerprint, pack_ok, inv_fns,
     unaffected (masking only fires on non-empty enabled sets), and
     masked lanes' overflow flags are dropped with them (a pruned
     successor is never materialized, so its capacity overflow cannot
-    abort the reduced run)."""
+    abort the reduced run).
+
+    ``fused_tail`` (the v3 pipeline, ops/pipeline_v3.py) replaces the
+    separate insert + enqueue stages with ONE fused Pallas kernel
+    ``(seen, kh, kl, kvalid, krows, cons_ok, next_count, qnext) ->
+    (seen, new, fail, qnext)`` (ops/fused_tail_pallas.py).  Requires
+    ``v2`` (the fused kernel consumes the delta fingerprints); the
+    constraint and row materialization move BEFORE the insert — they
+    depend only on the compacted candidates, so every carry field stays
+    bit-identical to the split path (the tests' contract)."""
     if enqueue_method not in ("scatter", "window", "pallas"):
         raise ValueError(f"unknown enqueue method {enqueue_method!r}")
     if (por_mask is None) != (por_priority is None):
         raise ValueError("por_mask and por_priority must be given together")
+    if fused_tail is not None and v2 is None:
+        raise ValueError("fused_tail (v3) requires the v2 delta pipeline")
     BG = B * G
     inv_id = build_inv_id(inv_fns) if inv_fns else None
 
@@ -164,7 +175,22 @@ def build_chunk_body(*, dims, expand, fingerprint, pack_ok, inv_fns,
             kh, kl, kstates = jax.vmap(v2.lane_out)(
                 kparents, kph, lane_id % G)
 
-        seen, new, fail = insert_fn(seen, kh, kl, kvalid)
+        if constraint is not None:
+            cons_ok = jax.vmap(constraint)(kstates)
+        else:
+            cons_ok = jnp.ones((K,), bool)
+        krows = jax.vmap(flatten_state, (0, None))(kstates, dims)
+        if fused_tail is not None:
+            # v3: one Pallas kernel probes/inserts the K keys and
+            # appends each novel constraint-passing row at the running
+            # cursor — the novelty bit never returns to HBM between the
+            # stages.  The constraint/rows above moved BEFORE the
+            # insert (they depend only on the candidates), so every
+            # value below is bit-identical to the split path.
+            seen, new, fail, qnext = fused_tail(
+                seen, kh, kl, kvalid, krows, cons_ok, next_count, qnext)
+        else:
+            seen, new, fail = insert_fn(seen, kh, kl, kvalid)
         if inv_id is not None:
             inv = jax.vmap(inv_id)(kstates)
         else:
@@ -173,13 +199,10 @@ def build_chunk_body(*, dims, expand, fingerprint, pack_ok, inv_fns,
         viol_any_b = jnp.any(viol)
         vpos = jnp.argmax(viol)
 
-        if constraint is not None:
-            cons_ok = jax.vmap(constraint)(kstates)
-        else:
-            cons_ok = jnp.ones((K,), bool)
-        krows = jax.vmap(flatten_state, (0, None))(kstates, dims)
         enq = new & cons_ok
-        if enqueue_method == "scatter":
+        if fused_tail is not None:
+            pass                        # rows already placed in-kernel
+        elif enqueue_method == "scatter":
             epos = next_count + jnp.cumsum(enq.astype(_I32)) - 1
             epos = jnp.where(enq, epos, Q + jnp.arange(K, dtype=_I32))
             qnext = qnext.at[epos].set(krows)

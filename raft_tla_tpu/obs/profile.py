@@ -31,6 +31,19 @@ Stage -> pipeline mapping (engine/chunk.py):
     dedup_insert  ops/fpset.py batched insert (in-batch dedup + probe)
     enqueue       materialize K uint8 rows + position scatter
 
+``pipeline="v3"`` switches to the FUSED-stage granularity of the v3
+chunk (ops/pipeline_v3.py) — the decomposition that actually runs
+there, so its table prices the fused kernels instead of a pipeline the
+engine is not executing:
+
+    masks           guards-only enabled/overflow masks (actions2)
+    compact         lane compaction (Pallas scan on TPU, XLA off it)
+    fingerprint     delta fingerprints + K-lane sparse rows
+    insert_enqueue  the fused probe/insert -> DMA-append tail
+
+``scripts/bench_diff.py`` folds the two granularities onto common
+coarse stages when diffing across pipelines.
+
 jax is imported lazily (constructor), keeping ``obs`` importable in
 device-less tooling like the rest of the package.
 """
@@ -42,6 +55,7 @@ import time
 from typing import Dict, Optional
 
 STAGES = ("expand", "fingerprint", "dedup_insert", "enqueue")
+STAGES_V3 = ("masks", "compact", "fingerprint", "insert_enqueue")
 
 STAGE_PREFIX = "chunk_stage/"
 
@@ -127,6 +141,97 @@ def build_stage_programs(dims, B: int, K: int,
     }
 
 
+def build_stage_programs_v3(dims, B: int, K: int,
+                            compact_method: str = "scatter",
+                            force: Optional[dict] = None) -> dict:
+    """Stage programs at the v3 fused-chunk granularity (STAGES_V3).
+
+    The decomposition mirrors engine/chunk.py's v3 path exactly: v2
+    guards-only masks, the plan-resolved compactor (Pallas where it
+    lowers), delta fingerprints + sparse K-lane rows, then the fused
+    probe/insert->enqueue tail.  ``force`` must be the ENGINE'S
+    ``EngineConfig.v3_force_stages`` so the per-stage plan resolution
+    matches the engine's.  Caveat: when the fused tail itself fell back,
+    this profiler's split-tail stand-in is the DEFAULT XLA pair
+    (fpset.insert + scatter) regardless of insert_method/enqueue_method
+    overrides — the fallback engine's exotic-override combinations are
+    not mirrored here.  Same return shape as
+    ``build_stage_programs``."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..models.actions2 import build_v2
+    from ..models.schema import flatten_state, state_width, unflatten_state
+    from ..ops import fpset
+    from ..ops import pipeline_v3
+    from ..ops.compact import build_compactor
+
+    _I32 = jnp.int32
+    G = dims.n_instances
+    v2 = build_v2(dims)
+    QP = K
+    # Re-resolved here (not reused from the engine) because the fused
+    # tail binds the queue capacity statically and the profiler runs
+    # against its own QP-row scratch queue — but the INPUTS that decide
+    # each stage's lowering (force, compact_method, platform) are the
+    # engine's, so the resolved lowerings match the engine's plan.
+    plan = pipeline_v3.resolve_plan(B, G, K, Q=QP, sw=state_width(dims),
+                                    force=force)
+    compactor = plan.compactor or build_compactor(B, G, K,
+                                                  method=compact_method)
+
+    def s_masks(rows, valid):
+        states = jax.vmap(unflatten_state, (0, None))(rows, dims)
+        en, _ovf = jax.vmap(v2.masks)(states)
+        return states, en & valid[:, None]
+
+    def s_compact(en):
+        _P, _total, lane_id, kvalid = compactor(en)
+        return lane_id, kvalid
+
+    def s_fingerprint(states, lane_id):
+        ph = jax.vmap(v2.parent_hash)(states)
+        pidx = lane_id // G
+        kparents = jax.tree.map(lambda a: a[pidx], states)
+        kph = jax.tree.map(lambda a: a[pidx], ph)
+        kh, kl, kstates = jax.vmap(v2.lane_out)(kparents, kph, lane_id % G)
+        krows = jax.vmap(flatten_state, (0, None))(kstates, dims)
+        return kh, kl, krows
+
+    def s_tail(seen, kh, kl, kvalid, krows, qnext):
+        cons = jnp.ones((K,), bool)
+        if plan.tail is not None:
+            seen, new, fail, qnext = plan.tail(
+                seen, kh, kl, kvalid, krows, cons, jnp.int32(0), qnext)
+        else:
+            seen, new, fail = fpset.insert(seen, kh, kl, kvalid)
+            pos = jnp.cumsum(new.astype(_I32)) - 1
+            pos = jnp.where(new, pos, QP + jnp.arange(K, dtype=_I32))
+            qnext = qnext.at[pos].set(krows, mode="drop")
+        # fail rides out so the profiler's insert_fail saturation
+        # counter guards v3 sampling exactly as it guards v1's.
+        return seen, qnext, new, fail
+
+    def s_total(rows, valid, seen, qnext):
+        states, en = s_masks(rows, valid)
+        lane_id, kvalid = s_compact(en)
+        kh, kl, krows = s_fingerprint(states, lane_id)
+        seen, qnext, new, _fail = s_tail(seen, kh, kl, kvalid, krows,
+                                         qnext)
+        return seen, qnext, jnp.sum(new, dtype=_I32)
+
+    return {
+        "masks": jax.jit(s_masks),
+        "compact": jax.jit(s_compact),
+        "fingerprint": jax.jit(s_fingerprint),
+        "insert_enqueue": jax.jit(s_tail),
+        "total": jax.jit(s_total),
+        "queue_rows": 2 * QP,
+        "empty_seen": lambda cap: fpset.empty(cap),
+        "plan": plan,
+    }
+
+
 class ChunkProfiler:
     """Samples every ``every``-th chunk call of one engine run.
 
@@ -137,17 +242,30 @@ class ChunkProfiler:
 
     def __init__(self, dims, *, batch: int, lanes: int,
                  seen_capacity: int, compact_method: str = "scatter",
-                 every: int = 1, metrics=None):
+                 pipeline: str = "v1", v3_force=None, every: int = 1,
+                 metrics=None):
         self.dims = dims
         self.B, self.K = int(batch), int(lanes)
         self.seen_capacity = int(seen_capacity)
         self.compact_method = compact_method
+        # The engine's EngineConfig.v3_force_stages, so the profiled v3
+        # stage lowerings are exactly the ones the engine runs.
+        self.v3_force = v3_force
+        # "v1" = the classical NORTHSTAR-budget decomposition (default,
+        # cross-pipeline comparable); "v3" = the fused-stage
+        # decomposition the v3 chunk actually executes.
+        if pipeline not in ("v1", "v3"):
+            raise ValueError(f"profiler pipeline must be v1/v3, "
+                             f"got {pipeline!r}")
+        self.pipeline = pipeline
+        self.stages = STAGES_V3 if pipeline == "v3" else STAGES
         self.every = max(1, int(every))
         self.metrics = metrics
         self.samples = 0
         self._calls = 0
         self._built = None
-        self._stage_totals: Dict[str, float] = {s: 0.0 for s in STAGES}
+        self._stage_totals: Dict[str, float] = {s: 0.0
+                                                for s in self.stages}
         self._total_total = 0.0
 
     def reset(self) -> None:
@@ -155,7 +273,7 @@ class ChunkProfiler:
         compiled stage programs and the persistent tables are kept."""
         self.samples = 0
         self._calls = 0
-        self._stage_totals = {s: 0.0 for s in STAGES}
+        self._stage_totals = {s: 0.0 for s in self.stages}
         self._total_total = 0.0
 
     # -- sampling ------------------------------------------------------
@@ -168,8 +286,13 @@ class ChunkProfiler:
     def _build(self, rows, valid):
         import jax
         import jax.numpy as jnp
-        progs = build_stage_programs(self.dims, self.B, self.K,
-                                     self.compact_method)
+        if self.pipeline == "v3":
+            progs = build_stage_programs_v3(self.dims, self.B, self.K,
+                                            self.compact_method,
+                                            force=self.v3_force)
+        else:
+            progs = build_stage_programs(self.dims, self.B, self.K,
+                                         self.compact_method)
         from ..models.schema import state_width
         sw = state_width(self.dims)
         self._qnext = jnp.zeros((progs["queue_rows"], sw), jnp.uint8)
@@ -177,16 +300,37 @@ class ChunkProfiler:
         self._seen_total = progs["empty_seen"](self.seen_capacity)
         # One untimed pass compiles every program, so compile time never
         # lands in the first sample's histogram bucket.
-        cflat, lane_id, kvalid = progs["expand"](rows, valid)
-        kstates, kh, kl = progs["fingerprint"](cflat, lane_id)
-        self._seen_staged, new, _f = progs["dedup_insert"](
-            self._seen_staged, kh, kl, kvalid)
-        self._qnext = progs["enqueue"](self._qnext, kstates, new)
+        self._staged_chain(progs, rows, valid)
         self._seen_total, self._qnext, n = progs["total"](
             rows, valid, self._seen_total, self._qnext)
         jax.block_until_ready((self._seen_staged, self._qnext, n))
         self._built = progs
         return progs
+
+    def _staged_chain(self, progs, rows, valid, fence=None):
+        """Run the per-stage programs in pipeline order, fencing each
+        when ``fence`` is given (the shared driver for warm-up and
+        sampling; one sequence per stage granularity)."""
+        fence = fence or (lambda stage, out: out)
+        if self.pipeline == "v3":
+            states, en = fence("masks", progs["masks"](rows, valid))
+            lane_id, kvalid = fence("compact", progs["compact"](en))
+            kh, kl, krows = fence(
+                "fingerprint", progs["fingerprint"](states, lane_id))
+            self._seen_staged, self._qnext, new, fail = fence(
+                "insert_enqueue", progs["insert_enqueue"](
+                    self._seen_staged, kh, kl, kvalid, krows,
+                    self._qnext))
+            return fail
+        cflat, lane_id, kvalid = fence(
+            "expand", progs["expand"](rows, valid))
+        kstates, kh, kl = fence(
+            "fingerprint", progs["fingerprint"](cflat, lane_id))
+        self._seen_staged, new, fail = fence("dedup_insert", progs[
+            "dedup_insert"](self._seen_staged, kh, kl, kvalid))
+        self._qnext = fence(
+            "enqueue", progs["enqueue"](self._qnext, kstates, new))
+        return fail
 
     def sample(self, rows, valid) -> None:
         """Profile one batch: ``rows`` [B, sw] device/host rows, ``valid``
@@ -211,24 +355,17 @@ class ChunkProfiler:
             return out
 
         fence.t0 = time.perf_counter()
-        cflat, lane_id, kvalid = fence(
-            "expand", progs["expand"](rows, valid))
-        kstates, kh, kl = fence(
-            "fingerprint", progs["fingerprint"](cflat, lane_id))
-        self._seen_staged, new, fail = fence("dedup_insert", progs[
-            "dedup_insert"](self._seen_staged, kh, kl, kvalid))
-        if mt is not None and bool(fail):
+        fail = self._staged_chain(progs, rows, valid, fence=fence)
+        if mt is not None and fail is not None and bool(fail):
             # The profiler's private table saturated: dedup_insert
             # timings from here on measure a pathologically full probe,
             # not the engine's.  Surfaced as a counter, never fatal.
             mt.counter("chunk_stage/insert_fail")
-        self._qnext = fence(
-            "enqueue", progs["enqueue"](self._qnext, kstates, new))
         self._seen_total, self._qnext, _n = fence("total", progs[
             "total"](rows, valid, self._seen_total, self._qnext))
 
         self.samples += 1
-        for s in STAGES:
+        for s in self.stages:
             self._stage_totals[s] += timings[s]
             if mt is not None:
                 mt.observe(STAGE_PREFIX + s, timings[s])
@@ -239,26 +376,32 @@ class ChunkProfiler:
     # -- reporting -----------------------------------------------------
     def stage_means(self) -> Dict[str, float]:
         """{stage: mean seconds/sampled batch} (+ ``total`` for the fused
-        reference) — what bench JSON embeds as ``chunk_stages``."""
+        reference) — what bench JSON embeds as ``chunk_stages``.  Keys
+        follow the profiled granularity (STAGES or STAGES_V3);
+        bench_diff folds mismatched granularities when diffing."""
         if not self.samples:
             return {}
-        out = {s: self._stage_totals[s] / self.samples for s in STAGES}
+        out = {s: self._stage_totals[s] / self.samples
+               for s in self.stages}
         out["total"] = self._total_total / self.samples
         return out
 
     def summary(self) -> dict:
         means = self.stage_means()
-        staged_sum = sum(means.get(s, 0.0) for s in STAGES)
+        staged_sum = sum(means.get(s, 0.0) for s in self.stages)
         return {
             "samples": self.samples,
             "every": self.every,
             "batch": self.B,
             "lanes": self.K,
+            "pipeline": self.pipeline,
             "stages": {s: {"mean_seconds": round(means[s], 6),
                            "total_seconds":
                                round(self._stage_totals[s], 6),
-                           "budget_ms_b2048": NORTHSTAR_BUDGET_MS[s]}
-                       for s in STAGES} if self.samples else {},
+                           # v3 stage names have no NORTHSTAR v1 budget
+                           # row; null, never a KeyError.
+                           "budget_ms_b2048": NORTHSTAR_BUDGET_MS.get(s)}
+                       for s in self.stages} if self.samples else {},
             "fused_total_mean_seconds": round(means.get("total", 0.0), 6),
             "staged_sum_mean_seconds": round(staged_sum, 6),
         }
@@ -266,20 +409,25 @@ class ChunkProfiler:
     def render_table(self) -> str:
         """Run-end stage-budget table: measured mean ms per stage next to
         NORTHSTAR §c's measured v1 budget (B=2048, v5e) — the shape
-        comparison that names which stage to fuse next."""
+        comparison that names which stage to fuse next.  v3 runs render
+        their fused-stage rows ("-" in the budget column: the v1 budget
+        has no such row) — coherent per-granularity output instead of a
+        KeyError on the new stage names."""
         means = self.stage_means()
         if not means:
             return "chunk profile: no samples"
         lines = [f"chunk profile ({self.samples} sampled batches, "
-                 f"B={self.B}, K={self.K}, every {self.every}th call):",
+                 f"B={self.B}, K={self.K}, every {self.every}th call, "
+                 f"{self.pipeline} stages):",
                  f"  {'stage':14s} {'mean ms':>10s} {'share':>7s} "
                  f"{'NORTHSTAR ms@B=2048':>20s}"]
-        staged_sum = sum(means[s] for s in STAGES)
-        for s in STAGES:
+        staged_sum = sum(means[s] for s in self.stages)
+        for s in self.stages:
             ms = means[s] * 1e3
             share = means[s] / staged_sum if staged_sum else 0.0
-            lines.append(f"  {s:14s} {ms:10.2f} {share:6.1%} "
-                         f"{NORTHSTAR_BUDGET_MS[s]:20.1f}")
+            budget = NORTHSTAR_BUDGET_MS.get(s)
+            btxt = f"{budget:20.1f}" if budget is not None else f"{'-':>20s}"
+            lines.append(f"  {s:14s} {ms:10.2f} {share:6.1%} {btxt}")
         lines.append(f"  {'sum(stages)':14s} {staged_sum * 1e3:10.2f}")
         lines.append(f"  {'fused total':14s} {means['total'] * 1e3:10.2f}"
                      f"  (inter-stage materialization the fused program "
@@ -297,7 +445,8 @@ class ChunkProfiler:
 
 def profile_stages(dims, rows, valid=None, *, lanes: Optional[int] = None,
                    seen_capacity: int = 1 << 20, n: int = 3,
-                   compact_method: str = "scatter") -> Dict[str, float]:
+                   compact_method: str = "scatter",
+                   pipeline: str = "v1") -> Dict[str, float]:
     """One-shot stage profile of a frontier batch — the
     ``scripts/profile_step.py`` entry point, now on the shared programs.
     Returns {stage: mean seconds} over ``n`` fenced repetitions (first
@@ -311,7 +460,8 @@ def profile_stages(dims, rows, valid=None, *, lanes: Optional[int] = None,
     prof = ChunkProfiler(
         dims, batch=B,
         lanes=lanes or choose_k(B, dims.n_instances, None),
-        seen_capacity=seen_capacity, compact_method=compact_method)
+        seen_capacity=seen_capacity, compact_method=compact_method,
+        pipeline=pipeline)
     for _ in range(n):
         prof.sample(rows, valid)
     return prof.stage_means()

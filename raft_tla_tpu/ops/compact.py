@@ -57,6 +57,15 @@ def inv_positions(mask, out_len: int):
                     0, mask.shape[0] - 1).astype(_I32)
 
 
+def kspread(B: int, G: int, K: int):
+    """Hash-spread addresses for dead compacted slots — the ONE
+    definition shared by every compact lowering (both methods here and
+    ops/compact_pallas.py), because lane_id bit-identity across
+    lowerings depends on all of them initializing dead slots from the
+    identical vector."""
+    return jnp.asarray((np.arange(K) * 2654435761) % (B * G), _I32)
+
+
 def build_compactor(B: int, G: int, K: int, reduce_p=None,
                     method: str = "scatter"):
     """Returns ``compact(en) -> (P, total, lane_id, kvalid)`` for a
@@ -92,7 +101,7 @@ def build_compactor(B: int, G: int, K: int, reduce_p=None,
       same spread addresses as "scatter"."""
     BG = B * G
     lane_f = jnp.arange(BG, dtype=_I32)
-    kspread = jnp.asarray((np.arange(K) * 2654435761) % BG, _I32)
+    kspr = kspread(B, G, K)
 
     def _prefix(en):
         per_parent = jnp.sum(en, axis=1, dtype=_I32)        # [B]
@@ -109,13 +118,13 @@ def build_compactor(B: int, G: int, K: int, reduce_p=None,
         P, total, enf, kvalid = _prefix(en)
         posk = jnp.cumsum(enf.astype(_I32)) - 1
         pos = jnp.where(enf, posk, K + (lane_f & (K - 1)))
-        lane_id = jnp.concatenate([kspread, kspread]) \
+        lane_id = jnp.concatenate([kspr, kspr]) \
             .at[pos].set(lane_f)[:K]
         return P, total, lane_id, kvalid
 
     def compact_searchsorted(en):
         P, total, enf, kvalid = _prefix(en)
-        lane_id = jnp.where(kvalid, inv_positions(enf, K), kspread)
+        lane_id = jnp.where(kvalid, inv_positions(enf, K), kspr)
         return P, total, lane_id, kvalid
 
     if method == "scatter":

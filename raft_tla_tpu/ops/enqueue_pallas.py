@@ -48,6 +48,7 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 from .compact import inv_positions
+from .pallas_compat import tpu_compiler_params
 
 _I32 = jnp.int32
 
@@ -76,17 +77,21 @@ def build_copy_plan(enq, next_count, K: int):
 
 def _kernel(src_ref, dst_ref, n_ref, krows_ref, q_in, q_ref, sem):
     del q_in   # aliased with q_ref — all access through the output ref
+    # Copy count read ONCE, before the loop: a while_loop whose
+    # condition reads a ref cannot be state-discharged by jax 0.4.x
+    # interpret mode (the body's DMA effects discharge fine).
+    n = n_ref[0]
 
-    def body(c):
+    def body(c, carry):
         cp = pltpu.make_async_copy(
             krows_ref.at[pl.ds(src_ref[c], SEG), :],
             q_ref.at[pl.ds(dst_ref[c], SEG), :],
             sem)
         cp.start()
         cp.wait()
-        return c + 1
+        return carry
 
-    jax.lax.while_loop(lambda c: c < n_ref[0], body, _I32(0))
+    jax.lax.fori_loop(0, n, body, _I32(0))
 
 
 @functools.partial(jax.jit, static_argnames=("interpret",))
@@ -108,7 +113,7 @@ def _enqueue_jit(qnext, next_count, krows, enq, interpret: bool):
         out_shape=jax.ShapeDtypeStruct(qnext.shape, qnext.dtype),
         scratch_shapes=[pltpu.SemaphoreType.DMA(())],
         input_output_aliases={4: 0},
-        compiler_params=pltpu.CompilerParams(has_side_effects=True),
+        compiler_params=tpu_compiler_params(has_side_effects=True),
         interpret=interpret,
     )(src, dst, n_copies[None], krows_pad, qnext)]
     return q_out

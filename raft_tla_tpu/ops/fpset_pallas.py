@@ -60,6 +60,7 @@ from jax.experimental.pallas import tpu as pltpu
 
 from .fingerprint import SENTINEL
 from .fpset import FPSet, PROBE_ROUNDS, _pad_pow2, _probe_base
+from .pallas_compat import tpu_compiler_params
 
 _U32 = jnp.uint32
 _I32 = jnp.int32
@@ -71,18 +72,14 @@ _I32 = jnp.int32
 _BLOCK = 512
 
 
-def _kernel(qhi_ref, qlo_ref, valid_ref,   # [BLK] VMEM in blocks
-            hi_in, lo_in,                  # [C] ANY in (aliased to outputs)
-            hi_ref, lo_ref,                # [C] ANY out — the same buffers;
-                                           # all reads+writes go through these
-            new_ref,                       # [BLK] VMEM out block
-            fail_ref,                      # [1] out, revisited by all programs
-            scr, sem,                      # VMEM (2,1) u32 scratch + 2 DMA sems
-            *, c_mask: int, rounds: int):
-    del hi_in, lo_in
-    @pl.when(pl.program_id(0) == 0)
-    def _():
-        fail_ref[0] = _I32(0)
+def probe_insert_query(hi_ref, lo_ref, scr, sem, qh, ql, pending0,
+                       c_mask: int, rounds: int):
+    """Sequentially probe/insert ONE key into the table refs — the inner
+    chain shared by this module's insert kernel and the fused
+    insert+enqueue kernel (ops/fused_tail_pallas.py), so the two
+    lowerings can never drift on probe order or claim semantics.
+    Returns ``(is_new, still_pending)``; table writes go through the
+    refs via single-element async copies."""
 
     def probe_round(carry):
         r, step, pending, newf, qh, ql, h1, h2 = carry
@@ -123,15 +120,32 @@ def _kernel(qhi_ref, qlo_ref, valid_ref,   # [BLK] VMEM in blocks
         r, _step, pending, *_ = carry
         return pending & (r < rounds)
 
+    h1, h2 = _probe_base(qh, ql, c_mask + 1)
+    _r, _s, pending, newf, *_ = jax.lax.while_loop(
+        probe_cond, probe_round,
+        (_I32(0), _U32(0), pending0, jnp.bool_(False), qh, ql, h1, h2))
+    return newf, pending
+
+
+def _kernel(qhi_ref, qlo_ref, valid_ref,   # [BLK] VMEM in blocks
+            hi_in, lo_in,                  # [C] ANY in (aliased to outputs)
+            hi_ref, lo_ref,                # [C] ANY out — the same buffers;
+                                           # all reads+writes go through these
+            new_ref,                       # [BLK] VMEM out block
+            fail_ref,                      # [1] out, revisited by all programs
+            scr, sem,                      # VMEM (2,1) u32 scratch + 2 DMA sems
+            *, c_mask: int, rounds: int):
+    del hi_in, lo_in
+    @pl.when(pl.program_id(0) == 0)
+    def _():
+        fail_ref[0] = _I32(0)
+
     def one_query(i, local_fail):
         qh = qhi_ref[i]
         ql = qlo_ref[i]
-        h1, h2 = _probe_base(qh, ql, c_mask + 1)
         pending0 = valid_ref[i] != 0
-        _r, _s, pending, newf, *_ = jax.lax.while_loop(
-            probe_cond, probe_round,
-            (_I32(0), _U32(0), pending0, jnp.bool_(False),
-             qh, ql, h1, h2))
+        newf, pending = probe_insert_query(hi_ref, lo_ref, scr, sem,
+                                           qh, ql, pending0, c_mask, rounds)
         new_ref[i] = newf.astype(_I32)
         return local_fail | pending.astype(_I32)
 
@@ -178,7 +192,7 @@ def _insert_padded(s: FPSet, qhi, qlo, valid, interpret: bool):
             pltpu.SemaphoreType.DMA((2,)),
         ],
         input_output_aliases={3: 0, 4: 1},
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=tpu_compiler_params(
             dimension_semantics=("arbitrary",),
             has_side_effects=True),
         interpret=interpret,

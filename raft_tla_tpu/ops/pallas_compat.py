@@ -1,0 +1,32 @@
+"""jax-version compatibility for the Pallas TPU kernels.
+
+The kernels target the current Pallas API (``pltpu.CompilerParams`` with
+``has_side_effects``); jax 0.4.x spells the class ``TPUCompilerParams``
+and moves side-effect declaration elsewhere.  Same situation as
+``utils.platform.compat_shard_map`` (which revived the whole parallel/
+layer on 0.4.x): one shim, so every kernel module builds its compiler
+params the same way on either API instead of each growing its own
+try/except.
+
+Unsupported fields are DROPPED, not errored: they are lowering hints
+(DCE protection, grid semantics) that only matter under a real Mosaic
+lowering — 0.4.x TPU deployments lose nothing the in-place
+``input_output_aliases`` contract doesn't already pin, and interpret
+mode (every CPU test) ignores compiler params entirely.
+"""
+
+from __future__ import annotations
+
+import inspect
+
+from jax.experimental.pallas import tpu as pltpu
+
+
+def tpu_compiler_params(**kwargs):
+    """``pltpu.CompilerParams(**kwargs)`` on current jax; on 0.4.x,
+    ``TPUCompilerParams`` with the unsupported fields dropped."""
+    cls = getattr(pltpu, "CompilerParams", None)
+    if cls is None:
+        cls = pltpu.TPUCompilerParams
+    fields = set(inspect.signature(cls).parameters)
+    return cls(**{k: v for k, v in kwargs.items() if k in fields})

@@ -1,0 +1,231 @@
+"""v3 fused-chunk stage plan — glue between EngineConfig.pipeline="v3"
+and the Pallas stage kernels.
+
+The v3 pipeline is the v2 delta pipeline (models/actions2.py semantics,
+bit-identical by construction) with the chunk's stages progressively
+moved into Pallas kernels so the K-lane survivor window stops
+round-tripping to HBM between stages (NORTHSTAR.md §c/§d):
+
+    masks        guards-only enabled/overflow masks      [always XLA]
+    compact      ops/compact_pallas.py sequential scan   [Pallas]
+    fingerprint  v2 delta fingerprints + sparse rows     [always XLA]
+    insert       ops/fused_tail_pallas.py                [Pallas, fused
+    enqueue        probe/insert -> DMA append             with insert]
+
+Two stages are XLA by design, not by fallback: the masks stage is the
+whole model's guard alphabet (a jaxpr program XLA already fuses into
+one kernel — a Pallas port would re-implement the spec), and the delta
+fingerprint is sparse gather arithmetic over the parent struct that
+only wins in Pallas once the struct itself is VMEM-resident (the
+staged next step).  The other stages resolve per platform/engine with
+AUTOMATIC fallback to the XLA lowering wherever a kernel cannot be
+built or probed — a v3 run never fails because one stage will not
+lower, it degrades that stage and records why (``V3Plan.stages`` /
+``reasons``, surfaced on ``EngineResult.fused_stages``).
+
+Platform policy (overridable per stage with ``force`` for tests):
+
+- TPU single chip: compact=pallas, insert+enqueue=fused.
+- CPU single chip: compact=xla (the sequential B*G scan is priced for
+  VMEM residency; interpret-mode emulation would dominate the chunk),
+  insert+enqueue=fused in interpret mode — the correctness-bearing
+  fused tail runs everywhere.
+- mesh: compact=xla (P is pmin-replicated across chips — a collective
+  cannot live inside a Pallas stage), insert=xla (owner-routed
+  all_to_all dedup is a collective), enqueue=pallas
+  (ops/enqueue_pallas.py rides inside shard_map).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, NamedTuple, Optional
+
+STAGES = ("masks", "compact", "fingerprint", "insert", "enqueue")
+
+
+class V3Plan(NamedTuple):
+    stages: Dict[str, str]       # stage -> "xla" | "pallas" | "fused"
+    reasons: Dict[str, str]      # stage -> why it is not Pallas/fused
+    compactor: Optional[Callable]   # Pallas compactor, or None = XLA
+    tail: Optional[Callable]     # fused insert+enqueue, or None = split
+    enqueue_method: str          # chunk-body enqueue when tail is None
+
+
+def describe(plan: V3Plan) -> str:
+    """One-line stage map for logs/results: "masks=xla compact=pallas ..."."""
+    return " ".join(f"{s}={plan.stages[s]}" for s in STAGES)
+
+
+def resolve_plan(B: int, G: int, K: int, *, Q: int, sw: int = 8,
+                 mesh: bool = False, enqueue_method: str = "scatter",
+                 force: Optional[Dict[str, str]] = None,
+                 interpret: Optional[bool] = None) -> V3Plan:
+    """Resolve the per-stage lowering for one engine build.
+
+    ``Q`` is the live next-queue capacity (the fused tail's trash base);
+    ``sw`` the packed state-row width (the tail probe's row shape).
+    ``force`` overrides the platform policy per stage ({"compact":
+    "pallas", ...}); "insert"/"enqueue" accept "fused" jointly — except
+    on the mesh, whose collective-coupled stages are not forceable.
+    Every Pallas choice is build-and-probe verified here at the REAL
+    per-program shapes (the full [B, G] mask; the tail's real K-query
+    grid and sw-byte rows, over small HBM extents), so a kernel that
+    cannot construct or lower its blocks falls back NOW with a recorded
+    reason instead of failing the first chunk.  Residual risk: a
+    lowering failure keyed to the total HBM extent (table/queue length)
+    would still surface at the first chunk compile — extents are the
+    one thing the probe shrinks."""
+    import jax
+    force = dict(force or {})
+    # Validate up front: a typo'd stage name or value must not silently
+    # degrade to the platform policy (a "forced full-Pallas" test would
+    # then compare XLA against XLA and pass vacuously).
+    _VALID = {"masks": ("xla",), "compact": ("pallas", "xla"),
+              "fingerprint": ("xla",), "insert": ("fused", "xla"),
+              "enqueue": ("fused", "pallas", "xla")}
+    for stage, impl in force.items():
+        if stage not in _VALID or impl not in _VALID[stage]:
+            raise ValueError(
+                f"v3_force_stages: unknown {stage!r}={impl!r}; valid: "
+                + ", ".join(f"{s}∈{v}" for s, v in _VALID.items()))
+    if interpret is None:
+        interpret = jax.devices()[0].platform != "tpu"
+
+    stages = {s: "xla" for s in STAGES}
+    reasons = {
+        "masks": "model guard alphabet; XLA fuses the guards-only pass",
+        "fingerprint": "delta arithmetic over the parent struct; Pallas "
+                       "win needs the VMEM-resident struct window "
+                       "(staged next)",
+    }
+    compactor = None
+    tail = None
+
+    # -- compact stage -------------------------------------------------
+    if mesh:
+        # Not overridable by force: the mesh compactor's P reduction is
+        # a pmin collective (and the engine would ignore a forced
+        # Pallas compactor anyway) — honoring the force here would make
+        # fused_stages claim a lowering that never runs.
+        want_compact = "xla"
+        reasons["compact"] = ("P is pmin-replicated across chips; a "
+                              "collective cannot live inside a "
+                              "Pallas stage")
+    else:
+        want_compact = force.get("compact")
+    if want_compact is None:
+        if interpret:
+            want_compact = "xla"
+            reasons["compact"] = ("sequential B*G scan is priced for TPU "
+                                  "VMEM residency; interpret-mode "
+                                  "emulation would dominate the CPU chunk")
+        else:
+            want_compact = "pallas"
+    if want_compact == "pallas":
+        try:
+            from . import compact_pallas
+            cand = compact_pallas.build_compactor(B, G, K,
+                                                  interpret=interpret)
+            import jax.numpy as jnp
+            jax.block_until_ready(cand(jnp.zeros((B, G), bool)))
+            compactor = cand
+            stages["compact"] = "pallas"
+            reasons.pop("compact", None)
+        except Exception as e:  # noqa: BLE001 — fallback is the contract
+            reasons["compact"] = (f"pallas compact failed to build/probe: "
+                                  f"{type(e).__name__}: {str(e)[:160]}")
+    elif "compact" not in reasons:
+        reasons["compact"] = "forced to xla"
+
+    # -- insert + enqueue (fused tail) ---------------------------------
+    if mesh:
+        # Not overridable by force: the mesh insert IS the owner-routed
+        # all_to_all dedup — a per-chip fused tail would dedup locally
+        # and silently double-count cross-chip duplicates.
+        want_tail = "xla"
+        reasons["insert"] = ("owner-routed all_to_all dedup is a "
+                             "collective; cannot fuse on the mesh")
+    else:
+        want_tail = force.get("insert", force.get("enqueue"))
+        if want_tail is None:
+            want_tail = "fused"
+    if want_tail == "fused":
+        try:
+            from . import fused_tail_pallas
+
+            def cand_tail(seen, kh, kl, kvalid, krows, cons_ok,
+                          next_count, qnext):
+                return fused_tail_pallas.insert_enqueue(
+                    seen, kh, kl, kvalid, krows, cons_ok, qnext,
+                    next_count, Q, interpret=interpret)
+
+            _probe_tail(K, sw, interpret)
+            tail = cand_tail
+            stages["insert"] = stages["enqueue"] = "fused"
+        except Exception as e:  # noqa: BLE001 — fallback is the contract
+            reasons["insert"] = (f"fused tail failed to build/probe: "
+                                 f"{type(e).__name__}: {str(e)[:160]}")
+    if tail is None and "insert" not in reasons:
+        reasons["insert"] = "forced to xla"
+
+    # -- split enqueue when the tail is not fused ----------------------
+    enq = enqueue_method
+    if tail is None:
+        want_enq = force.get("enqueue")
+        if want_enq in ("pallas", "xla"):
+            enq = "scatter" if want_enq == "xla" else "pallas"
+        elif mesh:
+            enq = "pallas"   # enqueue_pallas inside shard_map
+        if enq == "pallas":
+            try:
+                _probe_enqueue(K, sw, interpret)
+                stages["enqueue"] = "pallas"
+            except Exception as e:  # noqa: BLE001 — fallback contract
+                reasons["enqueue"] = (f"pallas enqueue failed to "
+                                      f"build/probe: {type(e).__name__}: "
+                                      f"{str(e)[:160]}")
+                enq = enqueue_method
+    return V3Plan(stages=stages, reasons=reasons, compactor=compactor,
+                  tail=tail, enqueue_method=enq)
+
+
+def _probe_enqueue(K: int, sw: int, interpret: bool) -> None:
+    """Compile-and-run the run-coalesced Pallas enqueue once at the real
+    per-copy shapes (K rows of sw bytes, empty mask) so lowering errors
+    degrade the stage at plan time.  The probe runs outside shard_map —
+    the kernel contains no collectives, so a per-chip lowering that
+    compiles solo compiles identically inside the mesh program."""
+    import jax
+    import jax.numpy as jnp
+
+    from . import enqueue_pallas
+    out = enqueue_pallas.enqueue(
+        jnp.zeros((2 * K, sw), jnp.uint8), jnp.int32(0),
+        jnp.zeros((K, sw), jnp.uint8), jnp.zeros((K,), bool),
+        interpret=interpret)
+    jax.block_until_ready(out)
+
+
+def _probe_tail(K: int, sw: int, interpret: bool) -> None:
+    """Compile-and-run the fused tail once at the REAL per-program
+    shapes — K queries (the real block size and grid), sw-byte rows —
+    over small HBM extents (a 256-slot table, a K-row queue with
+    trash_base=0), so per-block Mosaic lowering errors surface at plan
+    time, not at the first chunk.  Only the total table/queue extents
+    (and the trash-base constant) differ from the engine's call."""
+    import jax
+    import jax.numpy as jnp
+
+    from . import fpset, fused_tail_pallas
+    seen = fpset.empty(256)
+    out = fused_tail_pallas.insert_enqueue(
+        seen,
+        jnp.arange(K, dtype=jnp.uint32),
+        jnp.arange(K, dtype=jnp.uint32),
+        jnp.zeros((K,), bool),          # all-invalid: no probe walking,
+        jnp.zeros((K, sw), jnp.uint8),  # the run is trash-copies only
+        jnp.zeros((K,), bool),
+        jnp.zeros((K, sw), jnp.uint8),
+        jnp.int32(0),
+        0, interpret=interpret)
+    jax.block_until_ready(out)

@@ -269,6 +269,22 @@ class MeshBFSEngine:
             return (qnext, next_count, seen_local, tbuf, tcount, n_new,
                     fail, vinfo)
 
+        # v3 on the mesh: the collective-coupled stages (pmin-replicated
+        # compact, owner-routed insert) stay XLA by design — the plan
+        # records why — and the enqueue stage rides the Pallas
+        # run-coalesced append inside shard_map.  Bit-identical either
+        # way (the engines' shared-body contract).
+        enqueue_method = cfg.enqueue_method
+        if cfg.pipeline == "v3":
+            from ..ops import pipeline_v3
+            self._v3_plan = pipeline_v3.resolve_plan(
+                B, G, K, Q=QL, sw=sw, mesh=True,
+                enqueue_method=cfg.enqueue_method,
+                force=cfg.v3_force_stages)
+            enqueue_method = self._v3_plan.enqueue_method
+        else:
+            self._v3_plan = None
+
         # The per-batch pipeline body is shared with the single-chip
         # engine (engine/chunk.py); here the insert routes fingerprints
         # to their owner chips, and P is pmin-replicated via the
@@ -278,7 +294,7 @@ class MeshBFSEngine:
             pack_ok=pack_ok, inv_fns=inv_fns, constraint=constraint,
             B=B, G=G, K=K, Q=QL, TQ=TQ, record_static=record_static,
             compactor=compactor, insert_fn=route_insert, v2=self._v2,
-            enqueue_method=cfg.enqueue_method,
+            enqueue_method=enqueue_method,
             por_mask=por_mask, por_priority=por_priority)
 
         def sharded_chunk(qcur, cur_counts, offset0, qnext, next_counts,
@@ -606,7 +622,12 @@ class MeshBFSEngine:
             self._trace_run_id = mh.build_min(self.mesh)(
                 int(time.time() * 1000) & 0x7FFFFFFF)
         res = EngineResult(
-            pipeline="v2" if self._v2 is not None else "v1",
+            pipeline=("v3" if self._v3_plan is not None
+                      else "v2" if self._v2 is not None else "v1"),
+            fused_stages=(dict(self._v3_plan.stages)
+                          if self._v3_plan is not None else {}),
+            fused_reasons=(dict(self._v3_plan.reasons)
+                           if self._v3_plan is not None else {}),
             por_instances=(self._por_table.certified
                            if self._por_table is not None else 0))
         self._cur_res = res     # run_end event reads it on error exits

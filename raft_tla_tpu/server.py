@@ -171,6 +171,10 @@ def _do_check(req):
         max_diameter=req.get("max_diameter"),
         record_trace=record_trace,
         check_deadlock=req.get("check_deadlock"),
+        # Successor pipeline (auto/v1/v2/v3 — v3 is the fused Pallas
+        # chunk); same request-over-directive precedence as every key.
+        pipeline=(req["pipeline"] if req.get("pipeline") is not None
+                  else base.pipeline),
         por=(bool(req["por"]) if req.get("por") is not None
              else base.por),
         por_table=(req["por_table"] if req.get("por_table") is not None
@@ -189,9 +193,12 @@ def _do_check(req):
                 por_key = hashlib.sha256(f.read()).hexdigest()
         else:
             por_key = cfg.por_table.fingerprint
+    # pipeline keys the cache: the chunk program differs per pipeline,
+    # so a v3 request must never be served a warm v2 engine (or vice
+    # versa).
     key = (ident, req.get("engine", "single"), cfg.batch,
            cfg.queue_capacity, cfg.seen_capacity, record_trace,
-           cfg.check_deadlock, cfg.por, por_key)
+           cfg.check_deadlock, cfg.pipeline, cfg.por, por_key)
     engine = _cache_get(_ENGINES, key, "engine_cache")
     if engine is None:
         engine_cls = None
@@ -218,6 +225,12 @@ def _do_check(req):
            "levels": list(res.levels), "stop_reason": res.stop_reason,
            "wall_seconds": round(res.wall_seconds, 3),
            "batch": engine.config.batch,      # resolved, for observability
+           # Which successor pipeline actually ran, and (v3) the
+           # resolved per-stage lowering plan — a stage that fell back
+           # to XLA is visible to the client, never silent.
+           "pipeline": res.pipeline,
+           "fused_stages": dict(res.fused_stages),
+           "fused_reasons": dict(res.fused_reasons),
            "action_counts": dict(res.action_counts),
            # (capacity-after, off-clock stall seconds) per seen-set
            # doubling — the SEEN_CAPACITY sizing evidence.

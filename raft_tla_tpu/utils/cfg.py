@@ -33,7 +33,9 @@ MAX_LOG, PLATFORM, CHECKPOINT_DIR, CHECKPOINT_EVERY, CHECKPOINT_INTERVAL,
 SPILL_DIR, TRACE_DIR, PROGRESS_SECONDS, EVENTS_OUT, KEEP_CHECKPOINTS,
 TRACE_OUT (Chrome-trace span file), PROFILE_CHUNKS (per-stage chunk
 profiling cadence), POR (statically-certified partial-order reduction),
-POR_TABLE (pre-certified reduction-table artifact path).
+POR_TABLE (pre-certified reduction-table artifact path), PIPELINE
+(successor pipeline: auto / v1 / v2 / v3 — v3 is the fused Pallas chunk,
+engine/bfs.py EngineConfig.pipeline).
 Precedence everywhere: CLI flag > cfg backend key > built-in default.
 """
 
@@ -83,6 +85,7 @@ _BACKEND_KEYS = {
     "PLATFORM", "CHECKPOINT_DIR", "CHECKPOINT_EVERY", "CHECKPOINT_INTERVAL",
     "SPILL_DIR", "TRACE_DIR", "PROGRESS_SECONDS", "EVENTS_OUT",
     "KEEP_CHECKPOINTS", "TRACE_OUT", "PROFILE_CHUNKS", "POR", "POR_TABLE",
+    "PIPELINE",
 }
 
 

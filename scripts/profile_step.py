@@ -16,6 +16,11 @@ that, this script times what the in-engine profiler can't:
   CHUNK                  the engine's real fused chunk program
   CHUNK x8               ditto, 8 batches per call (sync_every)
   CHUNK v2 / v2+ss+win   the delta pipeline + full candidate config
+  v3 staged + CHUNK v3   the fused Pallas pipeline (ops/pipeline_v3.py):
+                         per-stage masks/compact/fingerprint/
+                         insert_enqueue timings and the whole v3 chunk —
+                         THE measurement row that resolves NORTHSTAR §d's
+                         fused-chunk decision at the next tunnel window
 
 Run:  python scripts/profile_step.py [batch]
 
@@ -254,6 +259,59 @@ def main():
     jax.block_until_ready(out3)
     print(f"{'CHUNK v2+ss+win x8 (full candidate)':42s} "
           f"{(time.time() - t0) / n / 8 * 1e3:9.2f} ms/batch")
+
+    # The v3 fused Pallas pipeline (NORTHSTAR §d decision row): the
+    # fused-stage decomposition, then the engine's whole v3 chunk.  On
+    # TPU this prices the real Mosaic kernels (Pallas compact + fused
+    # probe/insert->enqueue tail); off-TPU it runs interpret mode — a
+    # correctness instrument, not a perf number.  Tolerant of a Mosaic
+    # lowering failure: the plan's per-stage fallback is part of what
+    # this row measures, so a fallen-back stage prints as such instead
+    # of aborting the session.
+    try:
+        from raft_tla_tpu.obs.profile import STAGES_V3
+        means3 = profile_stages(dims, np.asarray(rows), lanes=K,
+                                seen_capacity=cfg.seen_capacity, n=10,
+                                pipeline="v3")
+        for s in STAGES_V3:
+            print(f"{'v3 ' + s + ' (staged, fenced)':42s} "
+                  f"{means3[s] * 1e3:9.2f} ms")
+        print(f"{'v3 staged total (one jit)':42s} "
+              f"{means3['total'] * 1e3:9.2f} ms")
+        engv3 = make_engine(setup, EngineConfig(
+            batch=B, queue_capacity=1 << 20, seen_capacity=1 << 23,
+            record_trace=False, check_deadlock=False, pipeline="v3"))
+        from raft_tla_tpu.ops.pipeline_v3 import describe
+        print(f"{'v3 plan':42s} {describe(engv3._v3_plan)}")
+        qnextf = jnp.zeros((QA, SW), jnp.uint8)
+        seenf = fpset.empty(cfg.seen_capacity)
+        tbuff = tuple(jnp.zeros((engv3._TA,), d) for d in
+                      (jnp.uint32, jnp.uint32, jnp.uint32, jnp.uint32,
+                       jnp.int32))
+
+        def chunk_f(qnext, seen, tbuf, nb):
+            return engv3._chunk(qcur, jnp.int32(nb * B), jnp.int32(0),
+                                qnext, jnp.int32(0), seen, tbuf,
+                                jnp.int32(0), jnp.int32(nb))
+
+        outf = chunk_f(qnextf, seenf, tbuff, 1)
+        jax.block_until_ready(outf)
+        t0 = time.time()
+        for _ in range(n):
+            outf = chunk_f(outf[0], outf[1], outf[2], 1)
+        jax.block_until_ready(outf)
+        print(f"{'CHUNK v3 (1 batch, fused pipeline)':42s} "
+              f"{(time.time() - t0) / n * 1e3:9.2f} ms")
+        outf = chunk_f(outf[0], outf[1], outf[2], 8)
+        jax.block_until_ready(outf)
+        t0 = time.time()
+        for _ in range(n):
+            outf = chunk_f(outf[0], outf[1], outf[2], 8)
+        jax.block_until_ready(outf)
+        print(f"{'CHUNK v3 x8 (8 batches per call)':42s} "
+              f"{(time.time() - t0) / n / 8 * 1e3:9.2f} ms/batch")
+    except Exception as e:  # noqa: BLE001 — report, keep the session
+        print(f"v3 pipeline                                FAILED: {e!r}")
 
 
 if __name__ == "__main__":

@@ -75,6 +75,35 @@ else
     echo "skipped: tunnel dead"
 fi
 
+echo "== 2c. bench --pipeline v3 (fused Pallas chunk, 60 s) =="
+# THE NORTHSTAR §d decision row: the fused Pallas pipeline (Pallas
+# compact + fused probe/insert->enqueue tail, real Mosaic lowering on
+# TPU) against the v2 XLA chunk measured in stage 2.  bench_diff folds
+# the two stage granularities to common stages; the verdict line in the
+# log is the §d decision rule resolved by measurement.  A Mosaic
+# lowering failure degrades per stage (recorded in fused_stages of the
+# JSON), so this stage can never wedge the session on an unlowered kernel.
+if probe; then
+    BENCH_SECONDS=60 BENCH_PIPELINE=v3 BENCH_ORACLE_SECONDS=1 \
+        timeout 900 python bench.py \
+        2> artifacts/bench_tpu_v3.log | tee artifacts/bench_tpu_v3.json \
+        || echo "bench v3 stage failed (rc=$?)"
+    python scripts/bench_diff.py artifacts/bench_tpu.json \
+        artifacts/bench_tpu_v3.json \
+        | tee artifacts/bench_tpu_v2_vs_v3.txt
+    # rc 1 is a measured perf verdict; rc 2 (malformed/missing JSON
+    # after a crashed bench) is NOT — never record a crash as the §d
+    # decision.  (pipefail makes the pipeline status bench_diff's rc.)
+    case $? in
+        0) echo "(v3 holds or beats v2 on this hardware)" ;;
+        1) echo "(v3 regressed vs v2 on this hardware — see diff above)" ;;
+        *) echo "(v2-vs-v3 diff UNAVAILABLE: bench JSON malformed or" \
+                "missing — a crashed measurement, not a perf verdict)" ;;
+    esac
+else
+    echo "skipped: tunnel dead"
+fi
+
 echo "== 3. leader-rich bench (60 s) =="
 if probe; then
     timeout 900 python scripts/leader_bench.py 60 \

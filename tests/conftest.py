@@ -54,7 +54,11 @@ def pytest_collection_modifyitems(config, items):
     def heavy(it):
         # test_por traces the same kernel set (plus every invariant
         # predicate) through the analyzers — same churn, same slot.
-        return "test_analysis" in it.nodeid or "test_por" in it.nodeid
+        # test_fused builds several whole engines (v2 + two v3 plans +
+        # a mesh) back to back — the same trace-churn profile, so it
+        # runs in the same trailing slot.
+        return ("test_analysis" in it.nodeid or "test_por" in it.nodeid
+                or "test_fused" in it.nodeid)
 
     analysis = [it for it in items if heavy(it)]
     if analysis and len(analysis) < len(items):
