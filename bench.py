@@ -137,6 +137,12 @@ def main():
     # stages in bench_diff.py).  The run's resolved pipeline + per-stage
     # plan are embedded in the JSON so two benches are always
     # attributable.
+    # Device-profiler capture (obs/profile.py XlaProfileCapture;
+    # BENCH_XLA_PROFILE=N traces the first N chunk calls): the
+    # hardware-truth artifacts for NORTHSTAR §d, landed under
+    # BENCH_XLA_PROFILE_DIR (default artifacts/xla_profile).
+    # Observational — the headline number is unaffected.
+    xla_profile = int(os.environ.get("BENCH_XLA_PROFILE", "0"))
     cfg = EngineConfig(
         batch=int(os.environ.get("BENCH_BATCH",
                                  str(2048 if on_accel else 512))),
@@ -148,6 +154,9 @@ def main():
         events_out=events_file,
         trace_out=os.environ.get("BENCH_TRACE_OUT"),
         profile_chunks_every=profile_every or None,
+        xla_profile_chunks=xla_profile or None,
+        xla_profile_dir=os.environ.get("BENCH_XLA_PROFILE_DIR",
+                                       "artifacts/xla_profile"),
         pipeline=os.environ.get("BENCH_PIPELINE", "auto"),
         por=bool(int(os.environ.get("BENCH_POR", "0"))),
         por_table=os.environ.get("BENCH_POR_TABLE"))
@@ -157,10 +166,41 @@ def main():
     n_dev = len(jax.devices())
     engine = make_engine(setup, cfg, engine_cls="auto")
     is_mesh = type(engine).__name__ == "MeshBFSEngine"
+    # Live introspection for the tunnel session (obs/expose.py):
+    # BENCH_METRICS_PORT serves /metrics (Prometheus) + /flight (the
+    # watch console's feed) for the duration of the run, so
+    # tpu_session.sh gets a live view of the measurement instead of
+    # staring at a silent 60 s window.
+    metrics_srv = None
+    metrics_port = int(os.environ.get("BENCH_METRICS_PORT", "0"))
+    if metrics_port:
+        from raft_tla_tpu.obs import start_metrics_server
+        from raft_tla_tpu.obs.flight import RECORDER
+        try:
+            metrics_srv, _t = start_metrics_server(metrics_port,
+                                                   engine.metrics,
+                                                   flight=RECORDER)
+            _mark(f"metrics listener on 127.0.0.1:"
+                  f"{metrics_srv.server_address[1]} (/metrics, /flight)")
+        except OSError as e:
+            # The listener is a nicety; the measurement is the point —
+            # a busy port must not kill a scarce tunnel-window bench.
+            metrics_srv = None
+            _mark(f"metrics listener unavailable on port "
+                  f"{metrics_port} ({e}); continuing without it")
     _mark(f"engine built ({'mesh' if is_mesh else 'single'}, "
           f"batch={cfg.batch}); compiling + running "
           f"{BENCH_SECONDS:.0f}s budget")
-    res = engine.run(initial_states(setup))
+    try:
+        res = engine.run(initial_states(setup))
+    finally:
+        if metrics_srv is not None:
+            metrics_srv.shutdown()
+            # server_close too: shutdown() alone leaves the bound
+            # socket accepting into the kernel backlog, which turns the
+            # watcher's clean connection-refused "listener gone" exit
+            # into per-poll read timeouts for the rest of the process.
+            metrics_srv.server_close()
     rate = res.distinct / res.wall_seconds if res.wall_seconds else 0.0
     _mark(f"engine run done: {res.distinct} distinct in "
           f"{res.wall_seconds:.1f}s; starting oracle window")
@@ -209,6 +249,12 @@ def main():
     base_rate = ores.distinct_states / base_wall if base_wall else 1.0
     _mark("oracle window done; emitting JSON")
 
+    # Host identity (obs/flight.py host_fingerprint): bench_diff.py
+    # prints a cross-host warning when two diffed benches disagree here
+    # — the PR 7 trap where BENCH_r05's absolute 38.4k/s was silently
+    # compared against a ~4x slower container.
+    from raft_tla_tpu.obs import host_fingerprint
+
     print(json.dumps({
         "metric": "distinct_states_per_sec",
         "value": round(rate, 1),
@@ -216,6 +262,7 @@ def main():
         "vs_baseline": round(rate / base_rate, 2) if base_rate else None,
         "platform": platform,
         "devices": n_dev,
+        "host_fingerprint": host_fingerprint(),
         "engine": "mesh" if is_mesh else "single",
         "distinct_states": res.distinct,
         "generated_states": res.generated,

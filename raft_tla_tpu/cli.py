@@ -132,6 +132,183 @@ def _run_analyze(args) -> int:
     return 0 if report.ok else 1
 
 
+def _render_watch_line(snap: dict) -> str:
+    """One console line per watch snapshot — the TLC-style progress
+    shape (obs/flight.py records), annotated with the run context."""
+    run = snap.get("run") or {}
+    prog = snap.get("progress") or {}
+    level = snap.get("level") or {}
+    parts = []
+    if prog:
+        parts.append(
+            f"distinct {prog.get('distinct', 0):,} | generated "
+            f"{prog.get('generated', 0):,} | diameter "
+            f"{prog.get('diameter', 0)} | frontier "
+            f"{prog.get('frontier', 0):,} | next "
+            f"{prog.get('next_count', 0):,} | elapsed "
+            f"{prog.get('elapsed', 0):,.0f}s")
+    elif level:
+        parts.append(
+            f"level {level.get('level')} done | distinct "
+            f"{level.get('distinct', 0):,} | generated "
+            f"{level.get('generated', 0):,}")
+    else:
+        parts.append("no telemetry yet")
+    ctx = " ".join(str(run[k]) for k in ("engine", "pipeline")
+                   if run.get(k))
+    live = "live" if snap.get("armed") else "idle"
+    return f"watch[{live}] {parts[0]}" + (f"  ({ctx})" if ctx else "")
+
+
+def _watch_http(url: str, interval: float, count: int, timeout: float,
+                as_json: bool) -> int:
+    """Poll a --metrics-port listener's /flight endpoint and render a
+    console; exits when the watched run's run_end shows up (or after
+    --count polls).  Tolerates a listener that is not up YET (the watch
+    is usually launched alongside the run) with a bounded retry."""
+    import json
+    import time
+    import urllib.error
+    import urllib.request
+    base = url.rstrip("/")
+    if not base.endswith("/flight"):
+        base += "/flight"
+    # Watchers render only the newest record per kind — ask the
+    # listener to trim (full-ring polls would serialize hundreds of KB
+    # per tick under the recorder lock the engine writes through).
+    poll_url = base + "?last=8"
+
+    def _refused(exc) -> bool:
+        """Connection REFUSED (listener torn down) vs merely slow
+        (timeout on a pegged host mid-compilation): only refusal means
+        the run process is gone."""
+        reason = getattr(exc, "reason", exc)
+        return isinstance(reason, ConnectionRefusedError)
+
+    sent = 0
+    refused = 0
+    attach_end_seq = None
+    t_start = time.monotonic()
+    t_last_ok = None
+    while True:
+        try:
+            with urllib.request.urlopen(poll_url, timeout=timeout) as r:
+                doc = json.loads(r.read().decode())
+            refused = 0
+            t_last_ok = time.monotonic()
+        except (OSError, urllib.error.URLError, ValueError) as e:
+            refused = refused + 1 if _refused(e) else 0
+            if sent and refused >= 3:
+                # The listener answered before and now actively refuses:
+                # the run process exited (the CLI tears the listener
+                # down at run end) — a completed watch, not a failure.
+                # Slow/timed-out polls (host pegged by compilation) do
+                # NOT count: the console must ride those out.
+                print("watch: listener gone — run process exited",
+                      flush=True)
+                return 0
+            # Give-up budgets are ELAPSED-time based (failure counts
+            # would stretch with the per-poll timeout): 300 s of
+            # silence after a successful poll, and a generous 600 s
+            # for the listener to come up at all — it only binds after
+            # jax import + backend init + engine build, which takes
+            # minutes on a cold TPU tunnel (the server watch op's
+            # in-process grace is shorter, 120 s, because there the
+            # backend is already up).
+            now = time.monotonic()
+            if sent and t_last_ok is not None and now - t_last_ok > 300.0:
+                print("watch: listener unresponsive too long; giving up",
+                      file=sys.stderr)
+                return 1
+            if not sent and now - t_start > 600.0:
+                print("watch: listener unreachable; giving up",
+                      file=sys.stderr)
+                return 1
+            time.sleep(interval)
+            continue
+        records = doc.get("records") or {}
+        events = records.get("event") or []
+        run_ends = [e for e in events if e.get("event") == "run_end"]
+        if attach_end_seq is None:
+            # First successful poll: note the newest pre-existing
+            # run_end so only a run ending AFTER attach closes the
+            # console.
+            attach_end_seq = run_ends[-1]["seq"] if run_ends else 0
+        snap = {
+            "armed": bool(doc.get("armed")),
+            "run": (records.get("run_context") or [None])[-1],
+            "progress": (records.get("progress") or [None])[-1],
+            "level": next((e for e in reversed(events)
+                           if e.get("event") == "level_complete"), None),
+        }
+        print(json.dumps(doc, default=str) if as_json
+              else _render_watch_line(snap), flush=True)
+        sent += 1
+        ended = run_ends and run_ends[-1]["seq"] > attach_end_seq
+        if ended:
+            end = run_ends[-1]
+            print(f"watch: run ended — stop_reason="
+                  f"{end.get('stop_reason')} distinct="
+                  f"{end.get('distinct')} generated="
+                  f"{end.get('generated')}", flush=True)
+            return 0
+        if count and sent >= count:
+            return 0
+        time.sleep(interval)
+
+
+def _watch_server(target: str, interval: float, count: int,
+                  timeout: float, as_json: bool) -> int:
+    """Attach to a checker service's streaming watch op and render each
+    snapshot line until the done record."""
+    import json
+    import socket
+    host, _, port = target.partition(":")
+    try:
+        s = socket.create_connection((host or "127.0.0.1",
+                                      int(port or 8610)), timeout=timeout)
+    except OSError as e:
+        print(f"watch: cannot connect to {target}: {e}", file=sys.stderr)
+        return 1
+    with s:
+        s.sendall((json.dumps({"op": "watch", "interval": interval,
+                               "count": count}) + "\n").encode())
+        # Snapshot lines arrive one per interval — reads must outlast it.
+        s.settimeout(max(timeout, interval * 3 + 5))
+        f = s.makefile("rb")
+        for line in f:
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                continue
+            if not rec.get("ok"):
+                print(f"watch: {rec.get('error')}", file=sys.stderr)
+                return 1
+            if rec.get("done"):
+                end = rec.get("run_end") or {}
+                print(f"watch: done after {rec.get('snapshots')} "
+                      f"snapshot(s)"
+                      + (f" — stop_reason={end.get('stop_reason')} "
+                         f"distinct={end.get('distinct')}"
+                         if end else ""), flush=True)
+                return 0
+            print(json.dumps(rec, default=str) if as_json
+                  else _render_watch_line(rec.get("watch") or {}),
+                  flush=True)
+    print("watch: connection closed by server", file=sys.stderr)
+    return 1
+
+
+def _run_watch(args) -> int:
+    """``watch``: run attach.  No jax, no cfg — pure client."""
+    if args.target.startswith("http://") \
+            or args.target.startswith("https://"):
+        return _watch_http(args.target, args.interval, args.count,
+                           args.timeout, args.json)
+    return _watch_server(args.target, args.interval, args.count,
+                         args.timeout, args.json)
+
+
 def _force_platform(platform: str):
     if platform == "cpu":
         from .utils.platform import force_cpu
@@ -264,6 +441,31 @@ def main(argv=None):
                         "stage-budget table on stderr at run end.  "
                         "Observational: engine results are bit-identical "
                         "with profiling on or off")
+    c.add_argument("--metrics-port", type=int, default=None,
+                   metavar="PORT",
+                   help="serve live telemetry over HTTP on 127.0.0.1:"
+                        "PORT for the duration of the run: /metrics is "
+                        "Prometheus text exposition of the engine's "
+                        "registry (point a scraper here), /flight is "
+                        "the flight-recorder ring as JSON (what "
+                        "`python -m raft_tla_tpu watch http://...` "
+                        "polls).  METRICS_PORT directive is the cfg "
+                        "fallback")
+    c.add_argument("--xla-profile", nargs="?", const=8, type=int,
+                   default=None, metavar="N",
+                   help="device-profiler capture (jax.profiler): trace "
+                        "the first N chunk calls (default 8) into "
+                        "--xla-profile-dir — XPlane protos + a "
+                        "Perfetto-openable trace of the actual "
+                        "XLA/Mosaic kernels, correlated with the "
+                        "--trace-out host spans via the shared 'chunk' "
+                        "span name.  Observational: results are "
+                        "bit-identical with the capture on or off.  "
+                        "XLA_PROFILE directive is the cfg fallback")
+    c.add_argument("--xla-profile-dir", default=None, metavar="DIR",
+                   help="where --xla-profile artifacts land (default: "
+                        "<--checkpoint-dir>/xla_profile, else "
+                        "./xla_profile)")
 
     a = sub.add_parser(
         "analyze",
@@ -309,6 +511,27 @@ def main(argv=None):
                    help="write the analysis/errors + analysis/warnings "
                         "counter snapshot here")
 
+    w = sub.add_parser(
+        "watch",
+        help="attach a live console to a running check (run attach): "
+             "stream progress/coverage/fused-stage snapshots from a "
+             "checker service's watch op, or poll a --metrics-port "
+             "listener's /flight endpoint")
+    w.add_argument("target", nargs="?", default="127.0.0.1:8610",
+                   help="HOST:PORT of a checker service (default "
+                        "%(default)s), or http://HOST:PORT of a "
+                        "--metrics-port listener")
+    w.add_argument("--interval", type=float, default=2.0,
+                   help="seconds between snapshots (default 2)")
+    w.add_argument("--count", type=int, default=0,
+                   help="snapshots before exiting; 0 (default) = until "
+                        "the watched run ends")
+    w.add_argument("--timeout", type=float, default=15.0,
+                   help="connect/read timeout per request (default 15)")
+    w.add_argument("--json", action="store_true",
+                   help="print raw snapshot JSON lines instead of the "
+                        "rendered console lines")
+
     s = sub.add_parser("simulate", help="random-trace simulation")
     common(s)
     # Default sized for the BASELINE workload (1M traces x depth 100 ~=
@@ -330,6 +553,12 @@ def main(argv=None):
                         "(sim_chunk/sim_fetch spans); opens in Perfetto")
 
     args = p.parse_args(argv)
+
+    if args.cmd == "watch":
+        # Pure client: no jax, no cfg, no platform — dispatched before
+        # any heavy import so the console attaches instantly even while
+        # the engine process owns the machine.
+        return _run_watch(args)
 
     if args.cmd == "analyze":
         # Dispatched before the cfg-directive platform sniff below: the
@@ -462,6 +691,9 @@ def main(argv=None):
             trace_out=resolve(args.trace_out, "TRACE_OUT", None),
             profile_chunks_every=resolve(args.profile_chunks,
                                          "PROFILE_CHUNKS", None),
+            xla_profile_chunks=resolve(args.xla_profile,
+                                       "XLA_PROFILE", None),
+            xla_profile_dir=args.xla_profile_dir,
             pipeline=resolve(args.pipeline, "PIPELINE", "auto"),
             por=bool(resolve(args.por or None, "POR", False)),
             por_table=resolve(args.por_table, "POR_TABLE", None),
@@ -497,9 +729,43 @@ def main(argv=None):
                 print(f"resuming from {resume}")
             else:
                 resume = args.resume
-        res = engine.run(
-            initial_states(setup, seed=args.seed) if resume is None else None,
-            resume=resume)
+        # Live exposition listener (obs/expose.py): /metrics for a
+        # Prometheus scraper, /flight for the watch console — up for
+        # exactly the duration of the run.
+        metrics_srv = None
+        metrics_port = resolve(args.metrics_port, "METRICS_PORT", None)
+        # 0 disables, matching BENCH_METRICS_PORT — a cfg author writing
+        # `METRICS_PORT = 0` to turn the listener off for one run must
+        # not get an unannounced ephemeral-port endpoint instead.
+        if metrics_port:
+            from .obs import start_metrics_server
+            from .obs.flight import RECORDER
+            try:
+                metrics_srv, _ = start_metrics_server(
+                    int(metrics_port), engine.metrics, flight=RECORDER)
+                print(f"metrics: http://127.0.0.1:"
+                      f"{metrics_srv.server_address[1]}/metrics "
+                      f"(+ /flight)", file=sys.stderr)
+            except OSError as e:
+                # Observability must never kill the run it observes: a
+                # busy/forbidden port degrades to a port-less run, said
+                # out loud.
+                metrics_srv = None
+                print(f"metrics: cannot listen on port {metrics_port} "
+                      f"({e}); continuing without the listener",
+                      file=sys.stderr)
+        try:
+            res = engine.run(
+                initial_states(setup, seed=args.seed)
+                if resume is None else None,
+                resume=resume)
+        finally:
+            if metrics_srv is not None:
+                metrics_srv.shutdown()
+                # And close the socket: a merely-shut-down server still
+                # accepts into the backlog, turning the watcher's clean
+                # refused-means-gone exit into read timeouts.
+                metrics_srv.server_close()
         print(format_result(res))
         if args.metrics_out:
             _write_metrics(args.metrics_out, engine.metrics)

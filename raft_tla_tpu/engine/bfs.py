@@ -42,6 +42,7 @@ at batch granularity.
 
 from __future__ import annotations
 
+import contextlib
 import dataclasses
 import os
 import time
@@ -62,6 +63,7 @@ from ..obs import (ActionCoverage, MetricsRegistry, RunEventLog,
                    SpanTracer, all_device_memory_stats,
                    device_memory_stats, events_path, peak_host_rss_bytes,
                    phase_delta)
+from ..obs.flight import RECORDER as _FLIGHT
 from ..resilience import faults as _faults
 from ..resilience.faults import is_resource_exhausted
 from ..ops import compact as compact_mod
@@ -208,6 +210,24 @@ class EngineConfig:
     # base plus a size-proportional allowance — the sibling of a large
     # local piece is probably still compressing its own.
     trace_merge_timeout_seconds: Optional[float] = None
+    # -- flight recorder / live introspection (obs/flight.py) ----------
+    # Directory for the crash postmortem dump (postmortem.json, written
+    # on an exception escaping the run, SIGTERM, or a fault-injected
+    # hard kill — never on a completed run).  None defers to
+    # checkpoint_dir; with neither set the dump is disabled (the
+    # in-memory flight ring still feeds watch/metrics-port attach).
+    postmortem_dir: Optional[str] = None
+    # Device-profiler capture (obs/profile.py XlaProfileCapture;
+    # --xla-profile[=N] / XLA_PROFILE directive): bracket the first N
+    # chunk calls of the run in a jax.profiler trace window, correlated
+    # with the SpanTracer's "chunk" spans by shared span name +
+    # step_num.  Artifacts land under xla_profile_dir (None =
+    # "<checkpoint_dir>/xla_profile", or "./xla_profile" without a
+    # checkpoint dir).  Observational: engine results are bit-identical
+    # with the capture on or off; a profiler that cannot start records
+    # its failure in the xla_profile event instead of raising.
+    xla_profile_chunks: Optional[int] = None
+    xla_profile_dir: Optional[str] = None
     # -- graceful degradation (resilience/) ----------------------------
     # Catch RESOURCE_EXHAUSTED from the run (chunk dispatch, buffer
     # allocation, seen-set growth): rebuild the engine at HALF the batch
@@ -504,6 +524,11 @@ class BFSEngine:
         if not hasattr(self, "tracer"):
             self.tracer = SpanTracer(cfg.trace_out)
         self.metrics.tracer = self.tracer
+        # Device-profiler capture is created per run (_telemetry_run);
+        # the attribute must exist (and survive re-entrant re-inits) so
+        # the chunk loop can always read it.
+        if not hasattr(self, "_xla_capture"):
+            self._xla_capture = None
         # Per-stage chunk profiler (obs/profile.py; --profile-chunks).
         # Rebuilt on re-entrant init: its stage programs are shaped by
         # the (possibly halved) batch.
@@ -862,7 +887,10 @@ class BFSEngine:
     def _telemetry_run(self, impl, init_states, resume=None):
         """Shared run_start/run_end bracketing (single-chip and mesh):
         event log, run/level spans, coverage + chunk-profile run-end
-        reporting, and the Chrome-trace write-out."""
+        reporting, the Chrome-trace write-out — and the flight
+        recorder's arm/disarm cycle: the black box is armed for the
+        whole run (postmortem on any abnormal death), and disarmed on
+        every completed run regardless of stop_reason."""
         cfg, mt = self.config, self.metrics
         self._evlog = evlog = RunEventLog(self._events_path())
         self._phase_base = mt.phase_seconds()
@@ -872,6 +900,30 @@ class BFSEngine:
             prof.reset()            # warm engines: samples are per-run
         if self.tracer.enabled:
             self.tracer.reset()     # one trace file = one run
+        # Black box armed before the first event so run_start itself is
+        # in the ring; the context snapshot is what the watch console
+        # shows as "what is running" (pipeline + resolved fused plan).
+        _FLIGHT.arm(
+            self._postmortem_path(), metrics=mt,
+            context={
+                "engine": type(self).__name__, "dims": repr(self.dims),
+                "batch": cfg.batch, "resume": resume is not None,
+                "pipeline": ("v3" if getattr(self, "_v3_plan", None)
+                             is not None
+                             else "v2" if getattr(self, "_v2", None)
+                             is not None else "v1"),
+                "fused_stages": (dict(self._v3_plan.stages)
+                                 if getattr(self, "_v3_plan", None)
+                                 is not None else {})})
+        _FLIGHT.set_live_evlog(evlog)
+        # Device-profiler capture is per-run (the window opens at the
+        # first chunk call, after warm-up compilation).
+        if cfg.xla_profile_chunks:
+            from ..obs import XlaProfileCapture
+            self._xla_capture = XlaProfileCapture(
+                self._xla_profile_dir(), cfg.xla_profile_chunks)
+        else:
+            self._xla_capture = None
         run_t0 = self._lvl_t0 = time.perf_counter()
         evlog.emit(
             "run_start", engine=type(self).__name__, dims=repr(self.dims),
@@ -914,12 +966,30 @@ class BFSEngine:
                 if res is not None:
                     res.chunk_stages = prof.stage_means()
                 prof.finish(evlog)
+            # Device-profiler window: close it (early-exit runs) and
+            # land the xla_profile event whether the run lived or died.
+            cap = getattr(self, "_xla_capture", None)
+            if cap is not None:
+                cap.finish(evlog)
+            # Postmortem: an exception escaping the run is an ABNORMAL
+            # end — dump the black box and stamp the path into run_end
+            # so the dump is discoverable from the event log alone.
+            # (SIGTERM / fault-kill deaths never reach here; their
+            # dumps come from the signal handler / faults._die.)
+            pm_path = None
+            if err is not None:
+                pm_path = _FLIGHT.dump(
+                    f"run error: {type(err).__name__}: {err}")
+                if pm_path is not None:
+                    evlog.emit("postmortem", dump={
+                        "path": pm_path, "reason": "run_error"})
             evlog.emit(
                 "run_end",
                 stop_reason=(getattr(res, "stop_reason", None)
                              if err is None else "error"),
                 error=(f"{type(err).__name__}: {err}" if err is not None
                        else None),
+                postmortem_path=pm_path,
                 distinct=getattr(res, "distinct", None),
                 generated=getattr(res, "generated", None),
                 diameter=getattr(res, "diameter", None),
@@ -934,6 +1004,8 @@ class BFSEngine:
                 # the field (obs/events.py guards).
                 host_rss_peak_bytes=peak_host_rss_bytes(),
                 devices_memory=all_device_memory_stats())
+            _FLIGHT.set_live_evlog(None)
+            _FLIGHT.disarm()     # completed or already-dumped: no atexit dump
             evlog.close()
             self._evlog = RunEventLog(None)
             if self.tracer.enabled:
@@ -947,6 +1019,22 @@ class BFSEngine:
         per-host piece suffixes."""
         return events_path(self.config.events_out,
                            self.config.checkpoint_dir)
+
+    def _postmortem_path(self):
+        """Where the flight recorder dumps on an abnormal death: next to
+        the checkpoints unless postmortem_dir overrides; None (no dir at
+        all) disables the dump.  The mesh engine overrides with per-host
+        piece suffixes, like the event log."""
+        d = self.config.postmortem_dir or self.config.checkpoint_dir
+        return os.path.join(d, "postmortem.json") if d else None
+
+    def _xla_profile_dir(self):
+        """--xla-profile artifact directory: explicit > next to the
+        checkpoints > ./xla_profile."""
+        cfg = self.config
+        if cfg.xla_profile_dir:
+            return cfg.xla_profile_dir
+        return os.path.join(cfg.checkpoint_dir or ".", "xla_profile")
 
     def _emit_level_event(self, res, frontier_rows):
         """level_complete: live counters + cumulative per-phase wall-time
@@ -965,8 +1053,10 @@ class BFSEngine:
             self.tracer.write()
         self._lvl_t0 = time.perf_counter()
         evlog = self._evlog
-        if not evlog.enabled:
-            return
+        # No enabled-check: emit() mirrors every event into the flight
+        # ring even on a file-less log, and the watch console's level
+        # rows come from exactly this record.  The per-level phase_delta
+        # below is a dict subtraction — noise next to a level of chunks.
         phases = phase_delta(self.metrics.phase_seconds(),
                              self._phase_base)
         elapsed = evlog.elapsed()
@@ -1351,7 +1441,17 @@ class BFSEngine:
                         _faults.fire("oom", level=res.diameter,
                                      chunk=calls_in_level)
                     t_call = time.time()
-                    with mt.phase_timer("chunk"):
+                    # Device-profiler window (--xla-profile): bracket
+                    # the dispatch in a StepTraceAnnotation sharing the
+                    # SpanTracer's "chunk" span name; the capture stops
+                    # itself after N steps (obs/profile.py).  One call
+                    # site: the profiled and unprofiled paths must
+                    # never diverge.
+                    cap = self._xla_capture
+                    step_cm = (cap.step() if cap is not None
+                               and not cap.done
+                               else contextlib.nullcontext())
+                    with mt.phase_timer("chunk"), step_cm:
                         out = self._chunk(qcur, jnp.int32(cur_count),
                                           jnp.int32(offset), qnext,
                                           jnp.int32(next_count_h), seen,
@@ -1402,6 +1502,17 @@ class BFSEngine:
                     coverage.add_chunk(int(st[12]), st[13:13 + F],
                                        st[13 + F:13 + 2 * F],
                                        st[13 + 2 * F:13 + 3 * F])
+                    # Black-box progress snapshot (obs/flight.py):
+                    # rate-limited inside progress(), so the always-on
+                    # cost is a couple of dict appends per second — and
+                    # the watch console / postmortem dump always have a
+                    # current view, with or without --progress-interval.
+                    _FLIGHT.progress(
+                        distinct=res.distinct, generated=res.generated,
+                        diameter=res.diameter, frontier=cur_count,
+                        offset=offset, next_count=next_count_h,
+                        seen_size=seen_size,
+                        elapsed=round(time.time() - t0, 3))
                     if cfg.record_trace and tcount:
                         with mt.phase_timer("trace_flush"):
                             self._flush_trace(trace, tbuf, tcount)

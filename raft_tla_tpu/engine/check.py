@@ -79,6 +79,7 @@ def engine_config_from_backend(setup: CheckSetup) -> EngineConfig:
         events_out=be.get("EVENTS_OUT"),
         trace_out=be.get("TRACE_OUT"),
         profile_chunks_every=be.get("PROFILE_CHUNKS"),
+        xla_profile_chunks=be.get("XLA_PROFILE"),
         pipeline=be.get("PIPELINE", EngineConfig.pipeline),
         por=bool(be.get("POR", False)),
         por_table=be.get("POR_TABLE"))

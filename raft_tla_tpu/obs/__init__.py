@@ -17,13 +17,22 @@ One spine, several legs:
   decomposition behind ``--profile-chunks`` (expand / fingerprint /
   dedup-insert / enqueue histograms + the run-end stage-budget table);
 - :mod:`.coverage` — :class:`ActionCoverage`, TLC-style per-action
-  generated/distinct/disabled counters and the run-end coverage table.
+  generated/distinct/disabled counters and the run-end coverage table;
+- :mod:`.flight` — the always-on :class:`FlightRecorder` black box
+  (bounded ring of recent events/progress/stage samples) with the
+  crash/SIGTERM/fault-kill **postmortem dump** and the process-global
+  :data:`~.flight.RECORDER` the live-introspection consumers read;
+- :mod:`.expose` — Prometheus text exposition of the registry
+  (``render_prometheus``/``parse_prometheus``) and the standalone
+  ``--metrics-port`` HTTP listener (``/metrics`` + ``/flight``) behind
+  the ``watch`` run-attach console.
 
 The CLI exposes them via ``--metrics-out`` / ``--events-out`` /
-``--trace-out`` / ``--profile-chunks``, the checker service via the
-``stats`` request, and ``bench.py`` embeds the phase breakdown, chunk
-stage means, and coverage in its JSON (``scripts/bench_diff.py`` gates
-on all three).  See README.md "Observability" for the schemas.
+``--trace-out`` / ``--profile-chunks`` / ``--metrics-port`` /
+``--xla-profile``, the checker service via the ``stats`` / ``metrics``
+/ ``watch`` requests, and ``bench.py`` embeds the phase breakdown,
+chunk stage means, and coverage in its JSON (``scripts/bench_diff.py``
+gates on all three).  See README.md "Observability" for the schemas.
 """
 
 from .metrics import (Histogram, MetricsRegistry, PHASE_PREFIX,  # noqa: F401
@@ -34,7 +43,11 @@ from .events import (KNOWN_EVENTS, REQUIRED_EVENTS, RunEventLog,  # noqa: F401
                      validate_and_cleanup, validate_run_events)
 from .tracing import SpanTracer, validate_chrome_trace           # noqa: F401
 from .coverage import ActionCoverage                             # noqa: F401
+from .flight import (FlightRecorder, RECORDER,                   # noqa: F401
+                     host_fingerprint)
+from .expose import (parse_prometheus, render_prometheus,        # noqa: F401
+                     serve_metrics, start_metrics_server)
 # .profile imports jax lazily but pulls model/ops modules at call time;
-# import the class here for the one-stop namespace (still jax-free at
+# import the classes here for the one-stop namespace (still jax-free at
 # import).
-from .profile import ChunkProfiler                               # noqa: F401
+from .profile import ChunkProfiler, XlaProfileCapture            # noqa: F401

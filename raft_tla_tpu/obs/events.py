@@ -24,6 +24,7 @@ from __future__ import annotations
 
 import json
 import os
+import threading
 import time
 from typing import Optional
 
@@ -42,10 +43,17 @@ KNOWN_EVENTS = (
     # Deep-profiling layer (obs/profile.py, obs/coverage.py):
     "chunk_profile",    # per-stage chunk timings; payload: "stages"
     "coverage",         # TLC-style per-action counters; payload: "actions"
+    # Flight-recorder / live-introspection layer (obs/flight.py,
+    # obs/expose.py):
+    "postmortem",       # a black-box dump was written; payload: "dump"
+    "watch_attach",     # a live watcher attached; payload: "client"
+    "xla_profile",      # device-profiler capture window; payload: "capture"
 )
 
 #: Structured payload field each new event type must carry.
-_EVENT_PAYLOAD_FIELDS = {"chunk_profile": "stages", "coverage": "actions"}
+_EVENT_PAYLOAD_FIELDS = {"chunk_profile": "stages", "coverage": "actions",
+                         "postmortem": "dump", "watch_attach": "client",
+                         "xla_profile": "capture"}
 
 
 #: memory_stats() keys kept in event payloads (one extraction for the
@@ -119,12 +127,20 @@ def events_path(events_out: Optional[str], checkpoint_dir: Optional[str],
 
 
 class RunEventLog:
-    """Append-only JSONL event writer; ``RunEventLog(None)`` discards."""
+    """Append-only JSONL event writer; ``RunEventLog(None)`` discards
+    the FILE half only — every emit is also mirrored into the
+    process-global flight recorder ring (obs/flight.py), which is how
+    a run with no event log configured still shows up in the ``watch``
+    console and the postmortem dump.  Thread-safe: the run's engine
+    thread and a watch attach (server handler thread) may emit into
+    one log concurrently, and interleaved partial lines would corrupt
+    the JSONL contract."""
 
     def __init__(self, path: Optional[str]):
         self.path = path
         self._f = None
         self._t0 = time.time()
+        self._lock = threading.Lock()
         if path is not None:
             d = os.path.dirname(os.path.abspath(path))
             os.makedirs(d, exist_ok=True)
@@ -141,19 +157,36 @@ class RunEventLog:
         return time.time() - self._t0
 
     def emit(self, event: str, **fields) -> None:
-        if self._f is None:
-            return
         now = time.time()
         rec = {"event": event, "ts": round(now, 6),
                "elapsed_seconds": round(now - self._t0, 6)}
         rec.update(fields)
+        # Flight-recorder mirror FIRST (before the file check): the ring
+        # is the always-on black box, fed even by file-less RunEventLog
+        # instances — a crash during a run with no --events-out still
+        # postmortems its recent events.  Lazy import avoids an import
+        # cycle at package init (flight is a sibling leg).
+        try:
+            from .flight import RECORDER
+            RECORDER.record("event", **rec)
+        except Exception:
+            pass
+        if self._f is None:
+            return
         # One line per event, flushed immediately: a crashed run's log
         # stays readable up to the crash (append-only, no buffering).
-        self._f.write(json.dumps(rec, default=str) + "\n")
-        self._f.flush()
+        # Under the lock: concurrent emitters (engine thread + a watch
+        # attach) must never interleave partial lines.
+        with self._lock:
+            f = self._f
+            if f is None:
+                return
+            f.write(json.dumps(rec, default=str) + "\n")
+            f.flush()
 
     def close(self) -> None:
-        f, self._f = self._f, None
+        with self._lock:
+            f, self._f = self._f, None
         if f is not None:
             f.close()
 

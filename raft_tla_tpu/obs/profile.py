@@ -372,6 +372,19 @@ class ChunkProfiler:
         self._total_total += timings["total"]
         if mt is not None:
             mt.observe(STAGE_PREFIX + "total", timings["total"])
+        # Black-box mirror (obs/flight.py): recent per-stage samples ride
+        # in the flight ring, so a postmortem dump carries the last
+        # chunk-stage timings even when the run never reached its
+        # chunk_profile run-end event.
+        try:
+            from .flight import RECORDER
+            RECORDER.record(
+                "chunk_stage", sample=self.samples,
+                pipeline=self.pipeline, batch=self.B,
+                stages={s: round(timings[s], 6) for s in self.stages},
+                total=round(timings["total"], 6))
+        except Exception:
+            pass
 
     # -- reporting -----------------------------------------------------
     def stage_means(self) -> Dict[str, float]:
@@ -441,6 +454,108 @@ class ChunkProfiler:
             return
         evlog.emit("chunk_profile", **self.summary())
         print(self.render_table(), file=stream or sys.stderr)
+
+
+class XlaProfileCapture:
+    """Opt-in ``jax.profiler`` trace window over N sampled chunk calls —
+    the hardware-truth layer (``--xla-profile[=N]`` / ``XLA_PROFILE``
+    directive).
+
+    The host-side chunk profiler above times WHOLE stage programs with
+    fences; it cannot see inside a program — which XLA/Mosaic kernels
+    run, their launch count, or HBM traffic.  That is exactly the
+    evidence NORTHSTAR §d's XLA-vs-Pallas decision needs, and
+    ``jax.profiler.start_trace`` captures it (XPlane protos + a
+    Perfetto-openable trace under ``<logdir>/plugins/profile/...``).
+
+    Correlation contract: each captured chunk dispatch is bracketed in
+    a ``jax.profiler.StepTraceAnnotation("chunk", step_num=i)`` — the
+    SAME span name the SpanTracer's ``phase_timer("chunk")`` records in
+    the ``--trace-out`` Chrome trace — so the device-profiler timeline
+    and the host span timeline line up by name + step index.
+
+    Observational and fail-soft: the capture never changes what the
+    engine computes, and a profiler that cannot start (unsupported
+    backend, missing permissions over a tunnel) records its failure in
+    the ``xla_profile`` event instead of killing the run.
+    """
+
+    def __init__(self, logdir: str, chunks: int):
+        self.logdir = logdir
+        self.chunks = max(1, int(chunks))
+        self.steps = 0
+        self.active = False
+        self.done = False
+        self.status: Optional[str] = None
+
+    def _start(self) -> None:
+        import jax
+        try:
+            import os
+            os.makedirs(self.logdir, exist_ok=True)
+            jax.profiler.start_trace(self.logdir)
+            self.active = True
+            self.status = "ok"
+        except Exception as e:
+            self.done = True
+            self.status = f"start failed: {type(e).__name__}: {e}"
+
+    def step(self):
+        """Context manager bracketing ONE chunk dispatch.  Starts the
+        trace lazily on the first call (so warm-up compilation never
+        pollutes the capture), annotates the step, and stops after
+        ``chunks`` calls.  A no-op once done."""
+        from contextlib import contextmanager
+
+        @contextmanager
+        def _cm():
+            if self.done:
+                yield
+                return
+            if not self.active:
+                self._start()
+                if self.done:           # start failed
+                    yield
+                    return
+            import jax
+            self.steps += 1
+            try:
+                with jax.profiler.StepTraceAnnotation(
+                        "chunk", step_num=self.steps):
+                    yield
+            finally:
+                if self.steps >= self.chunks:
+                    self.stop()
+        return _cm()
+
+    def stop(self) -> None:
+        if not self.active:
+            return
+        self.active = False
+        self.done = True
+        import jax
+        try:
+            jax.profiler.stop_trace()
+        except Exception as e:
+            self.status = f"stop failed: {type(e).__name__}: {e}"
+
+    def summary(self) -> dict:
+        """The ``xla_profile`` event's ``capture`` payload object."""
+        return {"logdir": self.logdir, "chunks": self.chunks,
+                "steps": self.steps,
+                "status": self.status or "never started",
+                "span_name": "chunk"}
+
+    def finish(self, evlog) -> None:
+        """Run-end hook: close an open window (early-exit runs) and emit
+        the ``xla_profile`` event + flight record."""
+        self.stop()
+        evlog.emit("xla_profile", capture=self.summary())
+        try:
+            from .flight import RECORDER
+            RECORDER.record("xla_profile", capture=self.summary())
+        except Exception:
+            pass
 
 
 def profile_stages(dims, rows, valid=None, *, lanes: Optional[int] = None,

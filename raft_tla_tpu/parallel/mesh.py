@@ -42,6 +42,7 @@ identical on a real TPU slice.
 
 from __future__ import annotations
 
+import contextlib
 import os
 import time
 from typing import Callable, Dict, List, Optional
@@ -64,6 +65,7 @@ from ..models.schema import (ROW_DTYPE, build_pack_guard, check_packable,
                              decode_state, encode_state, flatten_state,
                              state_width, unflatten_state)
 from ..obs import MetricsRegistry, RunEventLog, events_path
+from ..obs.flight import RECORDER as _flight_rec
 from ..ops import compact as compact_mod
 from ..ops import fpset
 from ..ops.fingerprint import SENTINEL, build_fingerprint
@@ -544,6 +546,21 @@ class MeshBFSEngine:
                            self.config.checkpoint_dir,
                            jax.process_index(), jax.process_count())
 
+    def _postmortem_path(self):
+        """One postmortem piece per controller (the event-log model):
+        two crashing controllers on a shared filesystem must never race
+        one dump file."""
+        from ..engine.bfs import BFSEngine
+        base = BFSEngine._postmortem_path(self)
+        if base is None:
+            return None
+        return events_path(base, None, jax.process_index(),
+                           jax.process_count())
+
+    def _xla_profile_dir(self):
+        from ..engine.bfs import BFSEngine
+        return BFSEngine._xla_profile_dir(self)
+
     def _emit_level_event(self, res, frontier_rows):
         from ..engine.bfs import BFSEngine
         BFSEngine._emit_level_event(self, res, frontier_rows)
@@ -967,7 +984,16 @@ class MeshBFSEngine:
                         _faults.fire("oom", level=res.diameter,
                                      chunk=calls_in_level)
                     t_call = time.time()
-                    with mt.phase_timer("chunk"):
+                    # Device-profiler window (--xla-profile): the mesh
+                    # brackets its sharded dispatch exactly like the
+                    # single-chip loop — same "chunk" span name, same
+                    # per-run capture object from _telemetry_run, one
+                    # call site (profiled/unprofiled must not diverge).
+                    cap = getattr(self, "_xla_capture", None)
+                    step_cm = (cap.step() if cap is not None
+                               and not cap.done
+                               else contextlib.nullcontext())
+                    with mt.phase_timer("chunk"), step_cm:
                         out = self._chunk(
                             qcur, cur_counts_dev,
                             jnp.int32(offset), qnext, next_counts, shi,
@@ -1010,6 +1036,16 @@ class MeshBFSEngine:
                     coverage.add_chunk(int(st[15]), st[16:16 + F],
                                        st[16 + F:16 + 2 * F],
                                        st[16 + 2 * F:16 + 3 * F])
+                    # Black-box progress snapshot (obs/flight.py;
+                    # rate-limited inside progress()) — the mesh feeds
+                    # the same watch/postmortem view as the single-chip
+                    # loop.
+                    _flight_rec.progress(
+                        distinct=res.distinct, generated=res.generated,
+                        diameter=res.diameter, frontier=int(st[9]),
+                        offset=offset, next_count=cur_sum,
+                        seen_size=int(st[10]),
+                        elapsed=round(time.time() - t0, 3))
                     if int(st[4]):
                         raise RuntimeError(
                             f"{int(st[4])} successors exceeded fixed-width "

@@ -173,6 +173,16 @@ class FaultPlan:
 
     def _die(self, fault: Fault) -> None:
         if self.hard:
+            # Flight-recorder postmortem first (obs/flight.py): a real
+            # SIGKILL would get nothing, but the POINT of the injected
+            # kill is to rehearse crash recovery — and the recorder's
+            # contract is that crashes yield their last N seconds of
+            # telemetry.  Best-effort: the dump never blocks the death.
+            try:
+                from ..obs.flight import RECORDER
+                RECORDER.dump(f"fault_kill: {fault}")
+            except Exception:
+                pass
             # Real crash semantics: no atexit hooks, no finally blocks —
             # exactly what a SIGKILL / machine loss leaves behind.
             os._exit(EXIT_FAULT)

@@ -93,9 +93,20 @@ def run_supervised(child_argv: List[str], checkpoint_dir: str,
                 argv += ["--resume", "auto"]
             ends_before = _count_run_ends(evpath)
             attempt_t0 = time.perf_counter()
+            attempt_wall_t0 = time.time()
             rc = subprocess.call(argv, env=env)
             tracer.complete("attempt", attempt_t0, attempt=attempt,
                             exit_code=rc)
+            if rc not in (0, 1):
+                # A crashed child's flight recorder dumps its black box
+                # next to the checkpoints (obs/flight.py; the injected
+                # hard kill dumps from faults._die).  Surface it in the
+                # supervision timeline so the postmortem is
+                # discoverable from the event log alone.
+                for pm in _find_postmortems(checkpoint_dir,
+                                            attempt_wall_t0):
+                    evlog.emit("postmortem", attempt=attempt,
+                               exit_code=rc, dump=pm)
             if rc == 0 or (rc == 1
                            and _completed_counterexample(evpath,
                                                          ends_before)):
@@ -136,6 +147,40 @@ def run_supervised(child_argv: List[str], checkpoint_dir: str,
         evlog.close()
         if tracer.enabled:
             tracer.write()
+
+
+def _find_postmortems(checkpoint_dir: str, since_ts: float) -> List[dict]:
+    """Postmortem dumps a child wrote during the attempt that just
+    crashed: ``postmortem.json`` plus any per-controller pieces
+    (``postmortem.p<i>of<m>.json``) under the checkpoint dir, filtered
+    by mtime so a previous attempt's dump is not re-reported.  Each
+    entry is the ``dump`` payload of one ``postmortem`` event: path,
+    reason, and a compact shape summary (record counts per kind) —
+    never the full ring, which belongs in the file."""
+    import glob
+    import json
+    import os
+    out = []
+    for path in sorted(
+            glob.glob(os.path.join(checkpoint_dir, "postmortem.json"))
+            + glob.glob(os.path.join(checkpoint_dir,
+                                     "postmortem.p*of*.json"))):
+        try:
+            if os.path.getmtime(path) < since_ts - 1.0:
+                continue
+            with open(path, encoding="utf-8") as f:
+                doc = json.load(f)
+            out.append({
+                "path": path,
+                "reason": doc.get("reason"),
+                "pid": doc.get("pid"),
+                "records": {k: len(v) for k, v
+                            in (doc.get("records") or {}).items()},
+                "last_progress": ((doc.get("records") or {})
+                                  .get("progress") or [None])[-1]})
+        except (OSError, ValueError):
+            continue
+    return out
 
 
 def _run_end_reasons(evpath: Optional[str]) -> Optional[Dict[str, List[str]]]:

@@ -35,10 +35,26 @@ response per line.  Requests:
        Live telemetry (obs/): per-op request counts and latency
        histograms, engine/sim LRU cache hit/miss/eviction counters.
        Served WITHOUT the device lock, so it answers while a check runs.
+    {"op": "metrics"}
+        -> {"ok": true, "content_type": "text/plain; version=0.0.4...",
+            "exposition": "<Prometheus text exposition>"}
+       The SAME registry as "stats", rendered in the Prometheus text
+       format (obs/expose.py) — point a scraper sidecar here, or mount
+       the standalone --metrics-port HTTP listener instead.  Also
+       served without the device lock.
+    {"op": "watch", "interval": 1.0, "count": 0}
+        -> a STREAM of lines (the one multi-line-response op): one
+           {"ok": true, "watch": {run, progress, level, coverage,
+            chunk_stage, seq, armed}} snapshot per interval, closed by
+           {"ok": true, "done": true, ...} when the watched run ends
+           (or after "count" snapshots; count 0 = until run end).
+       Run attach (obs/flight.py): snapshots come from the in-memory
+       flight ring, not the event file — a check with no --events-out
+       is still watchable.  Never takes the device lock.
 
 Errors: {"ok": false, "error": "<message>"}.  check/simulate are served
 one at a time (a checking run owns the device); concurrent connections
-queue.  ping/stats never queue behind them.
+queue.  ping/stats/metrics/watch never queue behind them.
 
 Run:  python -m raft_tla_tpu.server [--port 8610] [--platform cpu]
 
@@ -286,6 +302,19 @@ def _do_simulate(req):
     return out
 
 
+def _do_metrics() -> dict:
+    """Prometheus text exposition of the same process-global registry
+    the ``stats`` op serves as JSON — one snapshot() call feeds both,
+    so the two views can never disagree about a counter taken in the
+    same instant (the acceptance contract tests exactly this)."""
+    from .obs.expose import (CONTENT_TYPE, default_labels,
+                             render_prometheus)
+    return {"ok": True,
+            "content_type": CONTENT_TYPE,
+            "exposition": render_prometheus(_METRICS.snapshot(),
+                                            labels=default_labels())}
+
+
 def _do_stats() -> dict:
     """The live-stats endpoint: the process-global registry verbatim
     (request counts, per-op latency histograms, LRU cache hit/miss/
@@ -304,8 +333,8 @@ def handle_request(req: dict) -> dict:
     # Metric names must not echo client-controlled strings: one counter +
     # histogram per distinct bogus op would grow the process-global
     # registry without bound in this long-lived service.
-    op_label = op if op in ("ping", "check", "simulate", "stats") \
-        else "unknown"
+    op_label = op if op in ("ping", "check", "simulate", "stats",
+                            "metrics") else "unknown"
     _METRICS.counter(f"server/requests/{op_label}")
     ok = False
     with _METRICS.phase_timer(f"request/{op_label}"):
@@ -316,6 +345,8 @@ def handle_request(req: dict) -> dict:
                         "platform": jax.devices()[0].platform}
             elif op == "stats":
                 resp = _do_stats()
+            elif op == "metrics":
+                resp = _do_metrics()
             elif op in ("check", "simulate"):
                 with _LOCK:
                     resp = (_do_check(req) if op == "check"
@@ -382,9 +413,82 @@ class _Handler(socketserver.StreamRequestHandler):
             except json.JSONDecodeError as e:
                 resp = {"ok": False, "error": f"bad json: {e}"}
             else:
+                if isinstance(req, dict) and req.get("op") == "watch":
+                    # The one streaming op: run attach emits one
+                    # snapshot line per interval on THIS connection,
+                    # then a done line; the connection then continues
+                    # serving normal requests.
+                    if not self._serve_watch(req):
+                        return
+                    continue
                 resp = handle_request(req)
             if not self._try_respond(resp):
                 return
+
+    def _serve_watch(self, req: dict) -> bool:
+        """Stream flight-recorder snapshots (obs/flight.py) until the
+        watched run ends, ``count`` snapshots have been sent, or the
+        client goes away.  Never touches the device lock — attach to a
+        server mid-check and the snapshots flow while the check runs.
+        Returns False when the client died (ends the handler)."""
+        import time as _time
+
+        from .obs.flight import RECORDER
+        _METRICS.counter("server/requests/watch")
+        try:
+            interval = min(max(float(req.get("interval", 1.0)), 0.05),
+                           60.0)
+            count = int(req.get("count", 0))
+        except (TypeError, ValueError) as e:
+            return self._try_respond(
+                {"ok": False, "error": f"bad watch params: {e}"})
+        # 0/negative = until run end — still bounded so an orphaned
+        # watcher cannot pin its handler thread forever.
+        limit = count if count > 0 else 3600
+        attach_seq = RECORDER.note_attach(
+            transport="server", peer=str(self.client_address[0]),
+            interval=interval, count=count)
+        sent = 0
+        saw_run = False
+        t_attach = _time.monotonic()
+        while True:
+            run_end = RECORDER.last_event("run_end")
+            snapshot = {
+                "seq": RECORDER.seq(), "armed": RECORDER.armed,
+                "run": RECORDER.last_record("run_context"),
+                "progress": RECORDER.last_record("progress"),
+                "level": RECORDER.last_event("level_complete"),
+                "coverage": RECORDER.last_event("coverage"),
+                "chunk_stage": RECORDER.last_record("chunk_stage"),
+            }
+            if not self._try_respond({"ok": True, "watch": snapshot}):
+                return False
+            sent += 1
+            ended = (run_end is not None
+                     and run_end["seq"] > attach_seq)
+            saw_run = saw_run or RECORDER.armed or ended
+            # Done when: the watched run ended after we attached; an
+            # explicit count is exhausted; or (count 0) the run we saw
+            # is gone / none ever started within the grace window — a
+            # watcher launched alongside its run must ride out engine
+            # construction + XLA compilation (tens of seconds on a cold
+            # cache), so the no-run-yet grace is time-based.
+            idle = (count <= 0 and not RECORDER.armed
+                    and (saw_run
+                         or _time.monotonic() - t_attach > 120.0))
+            if sent >= limit or ended or idle:
+                # Re-read: the run can end (emit run_end, then disarm)
+                # between the loop-top read and the idle computation —
+                # the done line must carry the freshest record, not a
+                # stale null.  Pre-attach run_ends stay out: the done
+                # line reports THIS watch's run or nothing.
+                end = RECORDER.last_event("run_end")
+                if end is not None and end["seq"] <= attach_seq:
+                    end = None
+                return self._try_respond(
+                    {"ok": True, "done": True, "snapshots": sent,
+                     "run_end": end})
+            _time.sleep(interval)
 
     def _try_respond(self, resp: dict) -> bool:
         """Best-effort one-line reply; False when the client is gone (a

@@ -35,7 +35,11 @@ TRACE_OUT (Chrome-trace span file), PROFILE_CHUNKS (per-stage chunk
 profiling cadence), POR (statically-certified partial-order reduction),
 POR_TABLE (pre-certified reduction-table artifact path), PIPELINE
 (successor pipeline: auto / v1 / v2 / v3 — v3 is the fused Pallas chunk,
-engine/bfs.py EngineConfig.pipeline).
+engine/bfs.py EngineConfig.pipeline), XLA_PROFILE (device-profiler
+capture: trace the first N chunk calls through jax.profiler,
+obs/profile.py XlaProfileCapture), METRICS_PORT (serve /metrics
+Prometheus exposition + /flight live snapshots over HTTP for the run,
+obs/expose.py).
 Precedence everywhere: CLI flag > cfg backend key > built-in default.
 """
 
@@ -85,7 +89,7 @@ _BACKEND_KEYS = {
     "PLATFORM", "CHECKPOINT_DIR", "CHECKPOINT_EVERY", "CHECKPOINT_INTERVAL",
     "SPILL_DIR", "TRACE_DIR", "PROGRESS_SECONDS", "EVENTS_OUT",
     "KEEP_CHECKPOINTS", "TRACE_OUT", "PROFILE_CHUNKS", "POR", "POR_TABLE",
-    "PIPELINE",
+    "PIPELINE", "XLA_PROFILE", "METRICS_PORT",
 }
 
 

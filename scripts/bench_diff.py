@@ -42,6 +42,11 @@ BENCH_r01–r05 files predate chunk_stages/coverage and still diff):
   number — identical models must produce identical mixes up to
   duration-budget truncation — so it defaults loose (5 pts).
 
+Additionally, when both runs embed a ``host_fingerprint`` (bench.py,
+BENCH_r06+), mismatched hardware/stack identity prints a loud
+cross-host WARNING note — absolute rates measured on different hosts
+must never be silently read as a trajectory.
+
 Improvements are reported but never fail.  Exit codes: 0 pass, 1 at
 least one regression, 2 malformed input/usage (consistent with the
 validate_run_events convention: a gate that cannot read its evidence
@@ -100,6 +105,35 @@ class Diff:
         print(f"bench_diff: {verdict} "
               f"({len(self.regressions)} regression(s))", file=stream)
         return 1 if self.regressions else 0
+
+
+#: host_fingerprint keys that make absolute rates incomparable when
+#: they differ (hostname alone does not: same container class, new pod).
+_FINGERPRINT_KEYS = ("cpu_model", "device_kind", "device_count",
+                     "platform", "jax", "jaxlib")
+
+
+def diff_host(old: dict, new: dict, d: Diff):
+    """Cross-host guard: when both benches carry a host_fingerprint
+    (bench.py, obs/flight.py) and they disagree on hardware/stack
+    identity, say so LOUDLY in the notes — the BENCH_r05 trap was an
+    absolute number silently compared across a ~4x slower container.
+    A note, not a regression: cross-host diffs are sometimes exactly
+    what the operator wants (e.g. CPU vs TPU), they just must never be
+    read as a regression gate."""
+    of, nf = old.get("host_fingerprint"), new.get("host_fingerprint")
+    if not of or not nf:
+        return
+    diffs = [k for k in _FINGERPRINT_KEYS if of.get(k) != nf.get(k)]
+    if diffs:
+        d.note("WARNING: benches ran on DIFFERENT hosts/stacks — "
+               "absolute rates are not comparable; fields: "
+               + ", ".join(f"{k}: {of.get(k)!r} -> {nf.get(k)!r}"
+                           for k in diffs))
+    else:
+        d.note("host fingerprints match "
+               f"({of.get('cpu_model') or 'unknown cpu'}, "
+               f"{of.get('device_kind') or of.get('platform')})")
 
 
 def diff_headline(old: dict, new: dict, d: Diff, max_regress: float):
@@ -275,6 +309,7 @@ def main(argv=None) -> int:
 
     print(f"bench_diff: {args.old} -> {args.new}")
     d = Diff()
+    diff_host(old, new, d)
     diff_headline(old, new, d, args.max_regress)
     diff_phases(old, new, d, args.phase_max_regress, args.phase_floor)
     diff_stages(old, new, d, args.stage_max_regress)

@@ -16,12 +16,36 @@
 #   3. leader bench    - leader-rich frontier (log-machinery kernels)
 #   4. profile_step    - per-stage device timings
 #   5. north star      - raft5/TPUraft.cfg on one chip, checkpoint+spill
+#   5b. xla profile    - device-profiler capture (--xla-profile) of the
+#                        v2 AND v3 chunks: the NORTHSTAR §d hardware
+#                        verdict survives even a cut-short session
 #   6. simulation      - BASELINE configs[3] scale (capped)
+#
+# Live console: the bench and north-star stages serve /metrics +
+# /flight on METRICS_PORT (obs/expose.py) and a background
+# `python -m raft_tla_tpu watch http://...` writes a live progress log
+# into artifacts/ — so a session that dies mid-measurement still shows
+# WHERE it was (and the engine's postmortem.json shows the last
+# seconds; it lands next to the north-star checkpoints).
 set -u
 set -o pipefail   # a crashed stage must not be masked by tee
 cd "$(dirname "$0")/.."
 mkdir -p artifacts
 NS_BUDGET="${1:-900}"
+METRICS_PORT="${METRICS_PORT:-8790}"
+
+# Background live console against a stage's /flight endpoint; writes to
+# the given log.  Dies on its own when the stage's listener goes away.
+start_watch() {
+    python -m raft_tla_tpu watch "http://127.0.0.1:${METRICS_PORT}" \
+        --interval 10 >> "artifacts/$1" 2>&1 &
+    WATCH_PID=$!
+}
+stop_watch() {
+    # The watcher exits by itself when the listener disappears; the
+    # kill is a backstop so a wedged stage can't leak watchers.
+    { kill "$WATCH_PID" 2>/dev/null && wait "$WATCH_PID" 2>/dev/null; } || true
+}
 
 probe() {
     # RAFT_SESSION_ALLOW_CPU=1 lets the whole pipeline be smoke-tested
@@ -61,9 +85,12 @@ echo "== 2. bench (60 s budget) =="
 for f in bench_tpu.json leader_bench_tpu.json; do
     [ -s "artifacts/$f" ] && cp "artifacts/$f" "artifacts/$f.$(date +%s).bak"
 done
-BENCH_SECONDS=60 timeout 900 python bench.py \
+start_watch bench_tpu_watch.log
+BENCH_SECONDS=60 BENCH_METRICS_PORT="${METRICS_PORT}" timeout 900 \
+    python bench.py \
     2> artifacts/bench_tpu.log | tee artifacts/bench_tpu.json \
     || echo "bench stage failed (rc=$?)"
+stop_watch
 
 echo "== 2b. bench at B=8192 (batch-scaling probe, 60 s) =="
 if probe; then
@@ -130,12 +157,40 @@ fi
 
 echo "== 5. north-star attempt (budget ${NS_BUDGET}s, ckpt+spill) =="
 if probe; then
+    # --metrics-port + the background watch console give the long run a
+    # live view; a mid-run death leaves artifacts/ns_ckpt/postmortem.json
+    # (flight recorder) with the last progress snapshots.
+    start_watch northstar_watch.log
     timeout $((NS_BUDGET + 600)) python -m raft_tla_tpu check \
         configs/TPUraft.cfg ${PLAT_ARGS} --max-seconds "${NS_BUDGET}" \
-        --no-trace \
+        --no-trace --metrics-port "${METRICS_PORT}" \
         --checkpoint-dir artifacts/ns_ckpt --spill-dir artifacts/ns_spill \
         2> artifacts/northstar_tpu.log | tee artifacts/northstar_tpu.txt \
         || echo "north-star stage failed (rc=$?)"
+    stop_watch
+else
+    echo "skipped: tunnel dead"
+fi
+
+echo "== 5b. device-profiler capture (--xla-profile, v2 then v3) =="
+# The NORTHSTAR §d hardware verdict needs to see INSIDE the chunk
+# program (kernel launches, HBM traffic) — jax.profiler artifacts
+# (XPlane + Perfetto trace), correlated with the host spans by the
+# shared "chunk" span name.  Short budgets: the capture window is the
+# first 16 chunk calls; even a session cut right after this stage has
+# the hardware profile for both pipelines.
+if probe; then
+    for pipe in v2 v3; do
+        timeout 600 python -m raft_tla_tpu check \
+            configs/MCraft_bounded.cfg ${PLAT_ARGS} --max-seconds 60 \
+            --no-trace --pipeline "$pipe" --xla-profile 16 \
+            --xla-profile-dir "artifacts/xla_profile_${pipe}" \
+            2> "artifacts/xla_profile_${pipe}.log" \
+            | tee "artifacts/xla_profile_${pipe}.txt" \
+            || echo "xla-profile ${pipe} stage failed (rc=$?)"
+    done
+    ls -R artifacts/xla_profile_v2 artifacts/xla_profile_v3 2>/dev/null \
+        | head -20
 else
     echo "skipped: tunnel dead"
 fi

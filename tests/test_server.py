@@ -149,6 +149,82 @@ def test_bad_request(server):
     assert resp["ok"] is False and "cfg" in resp["error"]
 
 
+def test_metrics_op_parses_and_agrees_with_stats(server):
+    """ISSUE 9 acceptance: the metrics op's output is valid Prometheus
+    text exposition and agrees with the stats op's counters taken in
+    the same instant (both render one snapshot of the same registry;
+    the check counter cannot move between the two reads — neither op
+    increments it)."""
+    from raft_tla_tpu.obs import parse_prometheus
+    from raft_tla_tpu.obs.expose import counter_sample
+    r = roundtrip(server, {
+        "op": "check",
+        "cfg": os.path.join(REPO, "configs/MCraft_bounded.cfg"),
+        "batch": 128, "max_diameter": 2,
+        "queue_capacity": 1 << 12, "seen_capacity": 1 << 15,
+        "check_deadlock": False})
+    assert r["ok"]
+    stats = roundtrip(server, {"op": "stats"})
+    m = roundtrip(server, {"op": "metrics"})
+    assert m["ok"] and m["content_type"].startswith("text/plain")
+    samples = parse_prometheus(m["exposition"])     # raises if invalid
+    counters = stats["metrics"]["counters"]
+    assert counter_sample(samples, "server/requests/check") \
+        == counters["server/requests/check"]
+    assert counter_sample(samples, "engine/distinct") \
+        == counters["engine/distinct"]
+    # Histogram family for the request latencies made it over too.
+    assert "raft_phase_request_check_bucket" in samples
+
+
+def test_watch_op_streams_live_run_snapshots(server):
+    """Run attach: a watch stream opened WHILE a check runs sees >= 1
+    progress snapshot recorded by that run (seq ordering proves it is
+    this run's telemetry, not a stale ring entry), then a done line
+    carrying the run_end."""
+    from raft_tla_tpu.obs.flight import RECORDER
+    seq0 = RECORDER.seq()
+    base = {"op": "check",
+            "cfg": os.path.join(REPO, "configs/MCraft_bounded.cfg"),
+            "batch": 128, "max_diameter": 6,
+            "queue_capacity": 1 << 12, "seen_capacity": 1 << 15,
+            "check_deadlock": False}
+    out = {}
+    th = threading.Thread(
+        target=lambda: out.update(resp=roundtrip(server, base)))
+    th.start()
+    got = []
+    with socket.create_connection(server, timeout=600) as s:
+        s.sendall((json.dumps({"op": "watch", "interval": 0.2})
+                   + "\n").encode())
+        s.settimeout(600)
+        for line in s.makefile("rb"):
+            rec = json.loads(line)
+            got.append(rec)
+            if rec.get("done"):
+                break
+    th.join()
+    assert out["resp"]["ok"], out["resp"]
+    assert got and got[-1].get("done")
+    snaps = [g["watch"] for g in got if "watch" in g]
+    assert snaps, got
+    fresh_progress = [s for s in snaps
+                      if s.get("progress")
+                      and s["progress"]["seq"] > seq0]
+    assert fresh_progress, "watch never saw this run's progress"
+    last = fresh_progress[-1]["progress"]
+    assert last["distinct"] > 0 and "diameter" in last
+    # The done line reports how the watched run ended.
+    end = got[-1].get("run_end")
+    assert end and end["seq"] > seq0
+    assert end["stop_reason"] == "diameter_budget"
+    # The attach left its mark in the run's durable event record too:
+    # watch_attach rides the flight ring (and the evlog when one is
+    # configured — the server runs file-less, so ring-only here).
+    att = RECORDER.last_record("watch_attach")
+    assert att is not None and att["client"]["transport"] == "server"
+
+
 def test_stats_request_reports_requests_and_cache_counters(server):
     """The live-stats endpoint (obs/): request counts, per-op latency
     histograms, and LRU cache hit/miss counters.  Self-contained: two
