@@ -255,7 +255,16 @@ def main():
     # compared against a ~4x slower container.
     from raft_tla_tpu.obs import host_fingerprint
 
-    print(json.dumps({
+    # Per-run identity shared by the printed JSON and the BENCH_HISTORY
+    # ledger line: bench_diff --history excludes the candidate's OWN
+    # entry by this id, so the record-then-gate workflow never
+    # self-compares even when the captured file is later annotated or
+    # reformatted (doc-equality alone would miss it then).
+    import secrets
+    run_id = secrets.token_hex(8)
+
+    doc = {
+        "run_id": run_id,
         "metric": "distinct_states_per_sec",
         "value": round(rate, 1),
         "unit": "states/s",
@@ -298,11 +307,28 @@ def main():
         # Certified ample instances the run's POR table carried (0 = POR
         # off or an all-conservative certificate).
         "por_instances": res.por_instances,
+        # TLC-parity statespace report (obs/report.py): collision
+        # probability, per-level table, out-degree, seen-set load —
+        # the semantic half of the trajectory the run ledger records.
+        "report": res.report,
         "baseline_states_per_sec": round(base_rate, 1),
         "baseline_distinct": ores.distinct_states,
         "baseline_wall_s": round(base_wall, 2),
         "baseline_kind": "python-oracle-1core (no TLC/java available)",
-    }))
+    }
+    print(json.dumps(doc))
+
+    # Run-history ledger (obs/history.py): BENCH_HISTORY names the
+    # append-only JSONL trajectory file — one entry per bench run,
+    # embedding the full bench object so scripts/bench_diff.py
+    # --history can auto-resolve its baseline (newest same-host entry)
+    # instead of a hand-picked file (the BENCH_r05 cross-host trap).
+    history_path = os.environ.get("BENCH_HISTORY")
+    if history_path:
+        from raft_tla_tpu.obs import history as history_mod
+        history_mod.append_entry(
+            history_path, history_mod.entry_from_bench(doc))
+        _mark(f"history entry appended to {history_path}")
 
 
 if __name__ == "__main__":

@@ -309,6 +309,17 @@ def _run_watch(args) -> int:
                          args.timeout, args.json)
 
 
+def _select_engine_cls(engine_arg: str):
+    """--engine -> make_engine's engine_cls: "auto" passes through (mesh
+    iff >1 accelerator device), "mesh" forces the mesh class, "single"
+    the default BFSEngine.  One copy for check and explain — the
+    selection rule must not fork per subcommand."""
+    if engine_arg == "mesh":
+        from .parallel.mesh import MeshBFSEngine
+        return MeshBFSEngine
+    return "auto" if engine_arg == "auto" else None
+
+
 def _force_platform(platform: str):
     if platform == "cpu":
         from .utils.platform import force_cpu
@@ -466,6 +477,34 @@ def main(argv=None):
                    help="where --xla-profile artifacts land (default: "
                         "<--checkpoint-dir>/xla_profile, else "
                         "./xla_profile)")
+    c.add_argument("--render-trace", action="store_true",
+                   help="force writing counterexample.{txt,json} even "
+                        "with no --counterexample-dir/--checkpoint-dir "
+                        "configured (falls back to the current "
+                        "directory).  The TLC-style rendered trace "
+                        "(numbered states, action names, changed-field "
+                        "diffs; engine/explain.py) is printed on every "
+                        "traced violation regardless")
+    c.add_argument("--counterexample-dir", default=None, metavar="DIR",
+                   help="where a traced violation's rendered "
+                        "counterexample.{txt,json} land automatically "
+                        "(default: --checkpoint-dir; neither set = no "
+                        "auto-write unless --render-trace forces one "
+                        "into the current directory)")
+    c.add_argument("--no-report", action="store_true",
+                   help="disable the TLC-parity statespace run report "
+                        "(obs/report.py: collision probability, "
+                        "per-level table, out-degree, seen-set load; "
+                        "REPORT directive is the cfg fallback).  "
+                        "Observational either way — engine counts are "
+                        "bit-identical report on or off")
+    c.add_argument("--history", default=None, metavar="FILE",
+                   help="append one run-history ledger entry (JSONL; "
+                        "obs/history.py: cfg/model/host fingerprints, "
+                        "verdict, counts, rates, report summary) after "
+                        "the run.  HISTORY directive is the cfg "
+                        "fallback; scripts/bench_history.py renders the "
+                        "trajectory")
 
     a = sub.add_parser(
         "analyze",
@@ -510,6 +549,38 @@ def main(argv=None):
     a.add_argument("--metrics-out", default=None,
                    help="write the analysis/errors + analysis/warnings "
                         "counter snapshot here")
+
+    e = sub.add_parser(
+        "explain",
+        help="run a check and render its counterexample the TLC way "
+             "(numbered states with action names and changed-field "
+             "diffs; text/json/html — engine/explain.py), and/or "
+             "export the full reached state graph of a small space "
+             "as DOT/GraphML")
+    common(e)
+    e.add_argument("--format", choices=("text", "json", "html"),
+                   default="text",
+                   help="counterexample rendering (default text — the "
+                        "TLC numbered-state error trace)")
+    e.add_argument("--out", default=None, metavar="FILE",
+                   help="write the rendering here instead of stdout")
+    e.add_argument("--max-diameter", type=int, default=None)
+    e.add_argument("--max-seconds", type=float, default=None)
+    e.add_argument("--queue-capacity", type=int, default=None)
+    e.add_argument("--seen-capacity", type=int, default=None)
+    e.add_argument("--graph", default=None, metavar="FILE",
+                   help="ALSO export the full reached state graph from "
+                        "the trace store (one node per fingerprint, one "
+                        "edge per recorded discovery) — small spaces "
+                        "only (see --graph-cap)")
+    e.add_argument("--graph-format", choices=("dot", "graphml"),
+                   default=None,
+                   help="graph dialect (default: from the --graph file "
+                        "extension, .graphml/.xml = GraphML, else DOT)")
+    e.add_argument("--graph-cap", type=int, default=None,
+                   help="refuse to export graphs larger than this many "
+                        "states (default 50000); raise deliberately for "
+                        "bigger spaces")
 
     w = sub.add_parser(
         "watch",
@@ -667,6 +738,74 @@ def main(argv=None):
 
     batch = resolve(args.batch, "BATCH", 1024)
 
+    if args.cmd == "explain":
+        # Counterexample explainer (engine/explain.py): run the check
+        # with trace recording FORCED on, then render the violation as
+        # TLC-style numbered states (and/or export the reached graph).
+        import json as _json
+
+        from .engine import explain as explain_mod
+        cfgobj = EngineConfig(
+            batch=batch,
+            queue_capacity=resolve(args.queue_capacity,
+                                   "QUEUE_CAPACITY", 1 << 20),
+            seen_capacity=resolve(args.seen_capacity,
+                                  "SEEN_CAPACITY", 1 << 22),
+            max_diameter=args.max_diameter, max_seconds=args.max_seconds,
+            record_trace=True,
+            pipeline=resolve(args.pipeline, "PIPELINE", "auto"))
+        engine = make_engine(setup, cfgobj,
+                             engine_cls=_select_engine_cls(args.engine))
+        res = engine.run(initial_states(setup, seed=args.seed))
+        rc = 0
+        if res.violation is not None:
+            steps = engine.replay(res.violation.fingerprint)
+            if args.format == "text":
+                doc = explain_mod.render_text(steps, setup.dims,
+                                              violation=res.violation)
+            elif args.format == "json":
+                doc = _json.dumps(
+                    explain_mod.render_json(steps, setup.dims,
+                                            violation=res.violation),
+                    indent=2, sort_keys=True) + "\n"
+            else:
+                doc = explain_mod.render_html(
+                    steps, setup.dims, violation=res.violation,
+                    title=f"counterexample: {res.violation.invariant}")
+            if args.out:
+                with open(args.out, "w", encoding="utf-8") as f:
+                    f.write(doc)
+                print(f"counterexample ({args.format}, {len(steps)} "
+                      f"states) -> {args.out}")
+            else:
+                print(doc, end="")
+            rc = 1            # same exit contract as check-on-violation
+        else:
+            print(format_result(res))
+            print("no violation found; nothing to explain"
+                  + (" (graph still exported)" if args.graph else ""))
+        if args.graph:
+            fmt = args.graph_format or (
+                "graphml" if args.graph.endswith((".graphml", ".xml"))
+                else "dot")
+            try:
+                text = explain_mod.export_graph(
+                    engine.trace, setup.dims, fmt=fmt,
+                    cap=(args.graph_cap
+                         if args.graph_cap is not None
+                         else explain_mod.GRAPH_CAP_DEFAULT))
+            except ValueError as exc:
+                print(f"explain: {exc}", file=sys.stderr)
+                # A found-and-rendered violation keeps its exit-1
+                # contract (same as check) — only a graph failure with
+                # nothing else to report is a usage error.
+                return rc or 2
+            with open(args.graph, "w", encoding="utf-8") as f:
+                f.write(text)
+            print(f"state graph ({fmt}, {len(engine.trace)} recorded "
+                  f"states) -> {args.graph}")
+        return rc
+
     if args.cmd == "check":
         cfgobj = EngineConfig(
             batch=batch,
@@ -698,6 +837,18 @@ def main(argv=None):
             por=bool(resolve(args.por or None, "POR", False)),
             por_table=resolve(args.por_table, "POR_TABLE", None),
             degrade_on_oom=not args.no_degrade,
+            statespace_report=(False if args.no_report
+                               else bool(resolve(None, "REPORT", True))),
+            # Auto-render workdir for counterexample.{txt,json}: flag >
+            # directive > checkpoint dir (engine default); with none of
+            # those, --render-trace forces the current directory so the
+            # rendering it promises always lands somewhere.
+            counterexample_dir=(
+                resolve(args.counterexample_dir, "COUNTEREXAMPLE_DIR",
+                        None)
+                or ("." if args.render_trace
+                    and not resolve(args.checkpoint_dir,
+                                    "CHECKPOINT_DIR", None) else None)),
             progress_interval_seconds=float(
                 resolve(args.progress_interval, "PROGRESS_SECONDS", 60.0)))
         # Fault injection (resilience/): the --fault-plan flag or the
@@ -710,11 +861,8 @@ def main(argv=None):
                          if cfgobj.checkpoint_dir else None)
         _faults.install_from_env(default_state_dir=state_default,
                                  text=args.fault_plan)
-        engine_cls = args.engine if args.engine == "auto" else None
-        if args.engine == "mesh":
-            from .parallel.mesh import MeshBFSEngine
-            engine_cls = MeshBFSEngine
-        engine = make_engine(setup, cfgobj, engine_cls=engine_cls)
+        engine = make_engine(setup, cfgobj,
+                             engine_cls=_select_engine_cls(args.engine))
         resume = None
         if args.resume:
             if args.resume == "auto":
@@ -769,17 +917,50 @@ def main(argv=None):
         print(format_result(res))
         if args.metrics_out:
             _write_metrics(args.metrics_out, engine.metrics)
+        history_path = resolve(args.history, "HISTORY", None)
+        if history_path:
+            # Run-history ledger (obs/history.py): one JSONL line per
+            # run — cfg/model/host fingerprints, verdict, counts,
+            # rates, report summary.  scripts/bench_history.py renders
+            # the trajectory.
+            from .obs import history as history_mod
+            from .obs.flight import host_fingerprint
+            with open(args.cfg) as f:
+                cfg_text = f.read()
+            history_mod.append_entry(
+                history_path,
+                history_mod.entry_from_result(
+                    "check", res, cfg_text=cfg_text, dims=setup.dims,
+                    host_fingerprint=host_fingerprint(),
+                    label=os.path.basename(args.cfg)))
+            print(f"history: entry appended to {history_path}",
+                  file=sys.stderr)
         if res.violation is not None:
             if args.no_trace:
                 print("\nviolating state (trace recording disabled):")
                 print(format_state(res.violation.state, setup.dims))
             else:
-                print("\ncounterexample trace:")
-                for g, st in engine.replay(res.violation.fingerprint):
-                    label = ("Initial state" if g < 0
-                             else setup.dims.describe_instance(g))
-                    print(f"-- {label}")
-                    print(format_state(st, setup.dims))
+                # TLC-style rendered error trace (engine/explain.py) —
+                # the one trace rendering, --render-trace or not.  The
+                # engine's run-end hook already replayed the chain (one
+                # expand dispatch per step) and rendered this exact
+                # text into counterexample.txt whenever a workdir was
+                # resolvable (--render-trace guarantees one via the "."
+                # fallback above), so print THAT file; only a run with
+                # no workdir (or a failed render) replays here.
+                print()
+                if res.counterexample:
+                    with open(res.counterexample["txt"],
+                              encoding="utf-8") as f:
+                        print(f.read(), end="")
+                    print(f"\ncounterexample written: "
+                          f"{res.counterexample['txt']} (+ .json)")
+                else:
+                    from .engine import explain as explain_mod
+                    steps = engine.replay(res.violation.fingerprint)
+                    print(explain_mod.render_text(
+                        steps, setup.dims, violation=res.violation),
+                        end="")
             return 1
         if res.deadlock is not None:
             print("\ndeadlock state:")

@@ -228,6 +228,23 @@ class EngineConfig:
     # its failure in the xla_profile event instead of raising.
     xla_profile_chunks: Optional[int] = None
     xla_profile_dir: Optional[str] = None
+    # -- semantic observability (obs/report.py, engine/explain.py) -----
+    # TLC-parity run report: assembled HOST-SIDE at run end from
+    # counters the loop already fetched (fingerprint collision
+    # probability, per-level frontier table, out-degree summary,
+    # seen-set load), emitted as a ``statespace`` run event, rendered
+    # as the TLC-style stderr block on progress-enabled runs, and
+    # surfaced on ``EngineResult.report`` / bench JSON / the server
+    # ``check`` response + ``statespace/*`` gauges.  Purely
+    # observational — engine counts are bit-identical with the report
+    # on or off (tested); False drops every surface.
+    statespace_report: bool = True
+    # Where the rendered counterexample (counterexample.txt + .json,
+    # engine/explain.py) is written automatically when a traced run
+    # finds a violation.  None defers to checkpoint_dir; with neither
+    # set the auto-write is disabled (CLI `check --render-trace` and
+    # the `explain` subcommand still render from the in-memory trace).
+    counterexample_dir: Optional[str] = None
     # -- graceful degradation (resilience/) ----------------------------
     # Catch RESOURCE_EXHAUSTED from the run (chunk dispatch, buffer
     # allocation, seen-set growth): rebuild the engine at HALF the batch
@@ -296,6 +313,19 @@ class EngineResult:
     # stats fetch, trace flush, spill, fpset growth, checkpoint, ... —
     # embedded in bench JSON and the run_end event.
     phases: Dict[str, float] = dataclasses.field(default_factory=dict)
+    # TLC-parity statespace report (obs/report.py build_report):
+    # collision probability, per-level table, out-degree, seen-set
+    # load.  {} when EngineConfig.statespace_report is off.
+    report: Dict = dataclasses.field(default_factory=dict)
+    # Per-level boundary snapshots feeding the report's level table
+    # ({level, frontier, distinct, generated, seen_size,
+    # seen_capacity}), appended by _emit_level_event.  A resumed run's
+    # pre-resume levels appear in the report with frontier width only.
+    level_stats: List = dataclasses.field(default_factory=list)
+    # Paths of the auto-rendered counterexample artifacts
+    # (engine/explain.py write_counterexample): {"txt": ..., "json":
+    # ..., "depth": n}, {} when no traced violation was rendered.
+    counterexample: Dict = dataclasses.field(default_factory=dict)
 
     @property
     def states_per_second(self) -> float:
@@ -894,6 +924,10 @@ class BFSEngine:
         cfg, mt = self.config, self.metrics
         self._evlog = evlog = RunEventLog(self._events_path())
         self._phase_base = mt.phase_seconds()
+        # Observed-collision base: the counter is process-cumulative
+        # (shared registries — server, warm engines), the report's
+        # "observed dual-key collisions" is per-run.
+        self._collision_base = mt.counter_value("engine/fp_collisions")
         self.coverage = None        # _run_impl installs this run's own
         prof = getattr(self, "_profiler", None)
         if prof is not None:
@@ -957,6 +991,48 @@ class BFSEngine:
                     # run with reporting enabled; same cadence knob here.
                     import sys as _sys
                     print(cov.render_table(), file=_sys.stderr)
+            # Counterexample auto-render (engine/explain.py): a traced
+            # violation writes <workdir>/counterexample.{txt,json}
+            # BEFORE the run_end emit so the event carries the path.
+            # A render failure (e.g. a detected fingerprint collision
+            # diverging the replay) is reported, never allowed to mask
+            # the run's own verdict.
+            ce_path = None
+            ce_dir = cfg.counterexample_dir or cfg.checkpoint_dir
+            if (err is None and res is not None
+                    and res.violation is not None
+                    and cfg.record_trace and ce_dir):
+                try:
+                    from .explain import write_counterexample
+                    res.counterexample = write_counterexample(
+                        self, res, ce_dir,
+                        basename=self._counterexample_base())
+                    ce_path = res.counterexample["txt"]
+                except Exception as e:
+                    import sys as _sys
+                    print(f"counterexample render failed: "
+                          f"{type(e).__name__}: {e}", file=_sys.stderr)
+            # TLC-parity statespace report (obs/report.py): host-side
+            # assembly over counters the loop already fetched — its own
+            # ``statespace`` event, ``statespace/*`` gauges, and the
+            # TLC-style stderr block on progress-enabled runs (the same
+            # cadence rule as the coverage table above).
+            if cfg.statespace_report and res is not None and err is None:
+                from ..obs import report as report_mod
+                observed = int(mt.counter_value("engine/fp_collisions")
+                               - self._collision_base)
+                res.report = report_mod.build_report(
+                    res, coverage=cov, level_stats=res.level_stats,
+                    seen_capacity=int(mt.gauge_value(
+                        "engine/seen_capacity")) or None,
+                    seen_size=int(mt.gauge_value("engine/seen_size")),
+                    observed_collisions=observed)
+                report_mod.feed_metrics(res.report, mt)
+                evlog.emit("statespace", report=res.report)
+                if cfg.progress_interval_seconds:
+                    import sys as _sys
+                    print(report_mod.render_report(res.report),
+                          file=_sys.stderr)
             # Re-read the profiler: OOM degradation re-enters __init__,
             # which rebuilds it for the halved batch — the run-end
             # report must come from the object that took the most
@@ -990,6 +1066,10 @@ class BFSEngine:
                 error=(f"{type(err).__name__}: {err}" if err is not None
                        else None),
                 postmortem_path=pm_path,
+                # Where the rendered counterexample landed (None when no
+                # traced violation was rendered) — the event log alone
+                # locates the artifact, like postmortem_path.
+                counterexample_path=ce_path,
                 distinct=getattr(res, "distinct", None),
                 generated=getattr(res, "generated", None),
                 diameter=getattr(res, "diameter", None),
@@ -1036,6 +1116,13 @@ class BFSEngine:
             return cfg.xla_profile_dir
         return os.path.join(cfg.checkpoint_dir or ".", "xla_profile")
 
+    def _counterexample_base(self) -> str:
+        """Basename stem for the auto-rendered counterexample files;
+        the mesh engine suffixes the controller piece id (the event-log
+        model) so two controllers on a shared filesystem never race one
+        file."""
+        return "counterexample"
+
     def _emit_level_event(self, res, frontier_rows):
         """level_complete: live counters + cumulative per-phase wall-time
         breakdown.  ``unattributed_seconds`` closes the accounting —
@@ -1053,6 +1140,20 @@ class BFSEngine:
             self.tracer.write()
         self._lvl_t0 = time.perf_counter()
         evlog = self._evlog
+        # Level snapshot for the statespace report's per-level table
+        # (obs/report.py): frontier width + cumulative counters + the
+        # seen-set gauges the chunk loop keeps current.  Host-side dict
+        # appends — observational by construction.
+        if self.config.statespace_report:
+            res.level_stats.append({
+                "level": res.diameter,
+                "frontier": int(frontier_rows),
+                "distinct": res.distinct,
+                "generated": res.generated,
+                "seen_size": int(self.metrics.gauge_value(
+                    "engine/seen_size")),
+                "seen_capacity": int(self.metrics.gauge_value(
+                    "engine/seen_capacity"))})
         # No enabled-check: emit() mirrors every event into the flight
         # ring even on a file-less log, and the watch console's level
         # rows come from exactly this record.  The per-level phase_delta
@@ -1342,6 +1443,12 @@ class BFSEngine:
             # level, mirroring the oracle's frontier sizes.
             res.levels.append(int(next_count)
                               + spill_next.total_rows())
+            # Seen gauges refreshed BEFORE the level-0 emit: its
+            # level_stats snapshot reads them, and on a warm shared
+            # registry the stale previous-run values would otherwise
+            # leak into this run's level-0 row.
+            mt.gauge("engine/seen_capacity", len(seen.hi))
+            mt.gauge("engine/seen_size", int(seen.size))
             self._emit_level_event(res, res.levels[-1])
             qcur, qnext = qnext, qcur
             cur_count = int(next_count)
@@ -1661,6 +1768,12 @@ class BFSEngine:
                 | np.asarray(fpl).astype(np.uint64)
             ok = np.asarray(en) & (fps == np.uint64(child_fp))
             if not ok.any():
+                # A replay that cannot reproduce a recorded child is the
+                # one place a 64-bit fingerprint collision becomes HOST-
+                # OBSERVABLE — counted so the statespace report's
+                # "observed dual-key collisions" reflects detections,
+                # not just the calculated probability.
+                self.metrics.counter("engine/fp_collisions")
                 raise RuntimeError(
                     f"replay divergence: no enabled candidate matches "
                     f"fp {child_fp:#018x} (recorded action {g_rec})")

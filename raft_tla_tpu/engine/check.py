@@ -82,7 +82,9 @@ def engine_config_from_backend(setup: CheckSetup) -> EngineConfig:
         xla_profile_chunks=be.get("XLA_PROFILE"),
         pipeline=be.get("PIPELINE", EngineConfig.pipeline),
         por=bool(be.get("POR", False)),
-        por_table=be.get("POR_TABLE"))
+        por_table=be.get("POR_TABLE"),
+        statespace_report=bool(be.get("REPORT", True)),
+        counterexample_dir=be.get("COUNTEREXAMPLE_DIR"))
 
 
 def make_engine(setup: CheckSetup,
@@ -195,6 +197,15 @@ def format_result(res: EngineResult) -> str:
         f"wall seconds       {res.wall_seconds:.2f}",
         f"states/sec         {res.states_per_second:.0f}",
     ]
+    if res.report:
+        col = res.report["collision"]
+        lines.append(
+            f"fp collision prob  {col['calculated']:.2e} calculated "
+            f"(optimistic); {col['observed_dual_key']} observed")
+        peak = res.report.get("frontier_peak")
+        if peak:
+            lines.append(f"widest level       {peak['level']} "
+                         f"({peak['frontier']:,} states)")
     if res.pipeline:
         line = f"pipeline           {res.pipeline}"
         if res.fused_stages:

@@ -1,0 +1,273 @@
+"""Counterexample explainer — TLC's decoded error trace, three ways.
+
+A violation leaves the engines holding raw material: a fingerprint, a
+predecessor chain in the trace store, and ``replay()`` (engine/bfs.py),
+which re-runs the expand kernel along the chain and yields the exact
+``[(action id, PyState)]`` path root-first.  TLC users never see any of
+that — they see numbered states with the taking action's name and the
+fields it changed.  This module is that rendering layer:
+
+- :func:`decode_steps` — replay output -> structured step records, each
+  carrying the action label (``dims.describe_instance``), the canonical
+  decoded state (``models/pystate.state_fields`` — the ONE formatter the
+  oracle/debug printouts also use), and the changed-field diff against
+  the previous step (``diff_states``);
+- :func:`render_text` — TLC's numbered-state error trace (``State 1:
+  <Initial predicate>`` ...), each state printed by ``format_state``
+  with a ``changed:`` summary line per step;
+- :func:`render_json` / :func:`render_html` — the same decoded trace as
+  a machine-readable document / a standalone self-contained HTML page;
+- :func:`write_counterexample` — the engines call this automatically on
+  any traced violation: ``<workdir>/counterexample.txt`` + ``.json``,
+  atomically written, path stamped into the ``run_end`` event;
+- :func:`export_graph` — for small spaces (``cap``-bounded), the FULL
+  reached state graph from the trace store as DOT or GraphML (node per
+  fingerprint, edge per recorded (parent, action) discovery).
+
+CLI surfaces: ``python -m raft_tla_tpu explain <cfg>`` and
+``check --render-trace`` (cli.py).  Strictly observational: everything
+here reads finished-run artifacts.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import List, Optional, Tuple
+
+from ..models.pystate import PyState, diff_states, format_state, state_fields
+
+#: Default node cap for full-graph export — past this a DOT file stops
+#: being readable or layoutable, and the export loop stops being cheap.
+GRAPH_CAP_DEFAULT = 50_000
+
+
+def action_label(g: int, dims) -> str:
+    """TLC's angle-bracket action name for a replay step (-1 = root)."""
+    return "Initial predicate" if g < 0 else dims.describe_instance(g)
+
+
+def decode_steps(steps: List[Tuple[int, PyState]], dims) -> List[dict]:
+    """Replay output -> structured, JSON-able step records (root first).
+
+    Each record: ``index`` (1-based, TLC numbering), ``action`` /
+    ``action_id``, ``state`` (the canonical ``state_fields`` view), and
+    ``changed`` (the ``diff_states`` delta against the previous step;
+    ``{}`` for the root)."""
+    out = []
+    prev: Optional[PyState] = None
+    for idx, (g, st) in enumerate(steps, 1):
+        out.append({
+            "index": idx,
+            "action_id": int(g),
+            "action": action_label(g, dims),
+            "state": state_fields(st, dims),
+            "changed": diff_states(prev, st, dims) if prev is not None
+            else {},
+        })
+        prev = st
+    return out
+
+
+def _fmt_changed(changed: dict) -> List[str]:
+    parts = []
+    for k, v in changed.items():
+        if k.startswith("messages."):
+            parts.append(f"{k}: {'; '.join(v)}")
+        else:
+            parts.append(f"{k}: {v[0]} -> {v[1]}")
+    return parts
+
+
+def render_text(steps: List[Tuple[int, PyState]], dims,
+                violation=None) -> str:
+    """TLC-style numbered error trace.  ``violation`` (an engine
+    ``Violation`` or None) heads the block the way TLC's "Error:
+    Invariant ... is violated" does."""
+    lines = []
+    if violation is not None:
+        lines.append(f"Error: Invariant {violation.invariant} is "
+                     f"violated (fingerprint "
+                     f"{violation.fingerprint:#018x}).")
+        lines.append("Error: The behavior up to this point is:")
+    prev: Optional[PyState] = None
+    for idx, (g, st) in enumerate(steps, 1):
+        lines.append(f"State {idx}: <{action_label(g, dims)}>")
+        if prev is not None:
+            changed = diff_states(prev, st, dims)
+            if changed:
+                lines.append("  changed: "
+                             + "; ".join(_fmt_changed(changed)))
+        lines.append(format_state(st, dims))
+        lines.append("")
+        prev = st
+    return "\n".join(lines).rstrip() + "\n"
+
+
+def render_json(steps: List[Tuple[int, PyState]], dims,
+                violation=None) -> dict:
+    doc = {
+        "counterexample": True,
+        "length": len(steps),
+        "depth": max(0, len(steps) - 1),
+        "states": decode_steps(steps, dims),
+    }
+    if violation is not None:
+        doc["invariant"] = violation.invariant
+        doc["fingerprint"] = hex(violation.fingerprint)
+    return doc
+
+
+_HTML_HEAD = """<!doctype html>
+<html><head><meta charset="utf-8"><title>{title}</title><style>
+body {{ font-family: ui-monospace, monospace; margin: 2em;
+        background: #fafafa; color: #1a1a1a; }}
+h1 {{ font-size: 1.1em; }}
+.err {{ color: #b00020; font-weight: bold; }}
+.step {{ border: 1px solid #ddd; border-radius: 6px; background: #fff;
+         margin: 0.8em 0; padding: 0.6em 1em; }}
+.act {{ font-weight: bold; color: #0b57d0; }}
+.chg {{ color: #7a5c00; margin: 0.3em 0; }}
+pre {{ margin: 0.4em 0 0 0; white-space: pre-wrap; }}
+</style></head><body>
+"""
+
+
+def render_html(steps: List[Tuple[int, PyState]], dims,
+                violation=None, title="counterexample") -> str:
+    """Standalone single-file HTML rendering (no external assets — the
+    artifact must open from a CI artifacts tab or an email)."""
+    import html as _html
+    out = [_HTML_HEAD.format(title=_html.escape(title))]
+    out.append(f"<h1>{_html.escape(title)}</h1>")
+    if violation is not None:
+        out.append(f"<p class=err>Invariant "
+                   f"{_html.escape(violation.invariant)} is violated "
+                   f"(fingerprint {violation.fingerprint:#018x}).</p>")
+    prev: Optional[PyState] = None
+    for idx, (g, st) in enumerate(steps, 1):
+        out.append("<div class=step>")
+        out.append(f"<div>State {idx}: <span class=act>&lt;"
+                   f"{_html.escape(action_label(g, dims))}&gt;"
+                   f"</span></div>")
+        if prev is not None:
+            changed = diff_states(prev, st, dims)
+            if changed:
+                out.append("<div class=chg>changed: "
+                           + _html.escape(
+                               "; ".join(_fmt_changed(changed)))
+                           + "</div>")
+        out.append(f"<pre>{_html.escape(format_state(st, dims))}</pre>")
+        out.append("</div>")
+        prev = st
+    out.append("</body></html>\n")
+    return "\n".join(out)
+
+
+RENDERERS = {"text": render_text, "json": render_json, "html": render_html}
+
+
+def _atomic_write(path: str, text: str) -> None:
+    d = os.path.dirname(os.path.abspath(path))
+    os.makedirs(d, exist_ok=True)
+    tmp = f"{path}.tmp{os.getpid()}"
+    with open(tmp, "w", encoding="utf-8") as f:
+        f.write(text)
+    os.replace(tmp, path)
+
+
+def write_counterexample(engine, res, workdir: str,
+                         basename: str = "counterexample") -> dict:
+    """Render the violation's replayed trace and write
+    ``<workdir>/<basename>.txt`` + ``.json`` (atomic).  Called by the
+    engines' shared telemetry wrapper on every traced violation —
+    single-chip and mesh alike (the mesh's ``replay`` merges its trace
+    pieces first, and under a process group each controller's files get
+    its piece suffix via ``engine._counterexample_base``).  Returns
+    ``{"txt": path, "json": path, "depth": n}``."""
+    steps = engine.replay(res.violation.fingerprint)
+    txt = os.path.join(workdir, f"{basename}.txt")
+    jsn = os.path.join(workdir, f"{basename}.json")
+    _atomic_write(txt, render_text(steps, engine.dims,
+                                   violation=res.violation))
+    doc = render_json(steps, engine.dims, violation=res.violation)
+    _atomic_write(jsn, json.dumps(doc, indent=2, sort_keys=True) + "\n")
+    return {"txt": txt, "json": jsn, "depth": doc["depth"]}
+
+
+# ---------------------------------------------------------------------------
+# Full reached-graph export (small spaces).
+
+def _graph_edges(trace, dims):
+    """Iterate the trace store's recorded discovery edges as
+    ``(fp, parent_fp, action_id)`` numpy columns plus the root set."""
+    fps, parents, actions = trace.edges()
+    return fps, parents, actions, set(trace.roots)
+
+
+def export_graph(trace, dims, fmt: str = "dot",
+                 cap: Optional[int] = GRAPH_CAP_DEFAULT) -> str:
+    """The full reached state graph (one node per recorded fingerprint,
+    one edge per (parent, action) discovery record — the BFS tree TLC's
+    ``-dump dot`` would draw) as DOT or GraphML text.
+
+    ``cap`` guards the export: a store larger than it raises ValueError
+    (the caller sees the real size and can raise the cap deliberately);
+    None disables the guard."""
+    if fmt not in ("dot", "graphml"):
+        raise ValueError(f"graph format must be dot/graphml, got {fmt!r}")
+    n = len(trace)
+    if cap is not None and n > cap:
+        raise ValueError(
+            f"trace store holds {n} states, over the graph-export cap "
+            f"{cap}; raise the cap explicitly for a graph this big")
+    fps, parents, actions, roots = _graph_edges(trace, dims)
+    if fmt == "dot":
+        lines = ["digraph statespace {",
+                 "  node [shape=box, fontname=monospace];"]
+        for fp in sorted(roots):
+            lines.append(f'  "{fp:#018x}" [style=filled, '
+                         f'fillcolor=lightblue, label="root\\n{fp:#x}"];')
+        for fp, par, g in zip(fps.tolist(), parents.tolist(),
+                              actions.tolist()):
+            if g < 0:
+                continue          # root records have no incoming edge
+            lines.append(f'  "{par:#018x}" -> "{fp:#018x}" '
+                         f'[label="{dims.describe_instance(int(g))}"];')
+        lines.append("}")
+        return "\n".join(lines) + "\n"
+    # GraphML
+    import html as _html
+    out = [
+        '<?xml version="1.0" encoding="UTF-8"?>',
+        '<graphml xmlns="http://graphml.graphdrawing.org/xmlns">',
+        '  <key id="action" for="edge" attr.name="action" '
+        'attr.type="string"/>',
+        '  <key id="root" for="node" attr.name="root" '
+        'attr.type="boolean"/>',
+        '  <graph id="statespace" edgedefault="directed">',
+    ]
+    seen_nodes = set()
+
+    def node(fp: int):
+        if fp in seen_nodes:
+            return
+        seen_nodes.add(fp)
+        r = ('<data key="root">true</data>' if fp in roots else "")
+        out.append(f'    <node id="n{fp:x}">{r}</node>')
+
+    for fp in sorted(roots):
+        node(fp)
+    for i, (fp, par, g) in enumerate(zip(fps.tolist(), parents.tolist(),
+                                         actions.tolist())):
+        node(fp)
+        if g < 0:
+            continue
+        node(par)
+        label = _html.escape(dims.describe_instance(int(g)))
+        out.append(f'    <edge id="e{i}" source="n{par:x}" '
+                   f'target="n{fp:x}">'
+                   f'<data key="action">{label}</data></edge>')
+    out.append("  </graph>")
+    out.append("</graphml>")
+    return "\n".join(out) + "\n"

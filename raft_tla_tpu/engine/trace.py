@@ -54,6 +54,16 @@ class PyTraceStore:
         actions = np.fromiter((g for _p, g in self._d.values()), np.int32, n)
         return fps, parents, actions
 
+    def edges(self):
+        """The recorded discovery edges as ``(fps, parents, actions)``
+        numpy columns — ``export()`` under its graph name.  Root records
+        carry action -1 (no incoming edge); one record per first
+        discovery, so the edge set is TLC's BFS tree, which is what the
+        full-graph export (engine/explain.py ``export_graph``) draws.
+        Shared by both store implementations (NativeTraceStore overrides
+        ``export`` only)."""
+        return self.export()
+
     def chain(self, fp: int) -> List[Tuple[int, int]]:
         """Walk back to a root; returns [(fp, action_into_fp)] root-first."""
         out = []

@@ -90,6 +90,28 @@ def type_ok_py(s: PyState, dims: RaftDims) -> bool:
     return ok
 
 
+def build_no_leader(dims: RaftDims):
+    """``NoLeaderElected`` — a DELIBERATELY FALSIFIABLE canary: asserts no
+    server ever reaches the Leader role, which any live election run
+    violates at the first ``BecomeLeader``.  It exists for the
+    counterexample tooling (engine/explain.py, the CI violation smoke):
+    checking it turns "model-check the spec" into "extract a minimal
+    election trace", the standard TLC trick for demonstrating the error
+    reporting path on a healthy model.  Never include it in a cfg that is
+    supposed to pass."""
+    from .dims import LEADER
+
+    def no_leader(st: StateBatch):
+        return jnp.all(st.role != LEADER)
+
+    return no_leader
+
+
+def no_leader_py(s: PyState, dims: RaftDims) -> bool:
+    from .dims import LEADER
+    return LEADER not in s.role
+
+
 @dataclasses.dataclass(frozen=True)
 class Bounds:
     """CONSTRAINT bounds for exhaustive runs (BASELINE.json configs)."""
@@ -153,7 +175,13 @@ def invariant_registry():
     default predicate set.  (A function, not a constant: safety.py is
     imported lazily to keep this module import-light.)"""
     from .safety import SAFETY_INVARIANTS
-    return {"TypeOK": build_type_ok, **SAFETY_INVARIANTS}
+    # NoLeaderElected is the deliberately falsifiable canary (see
+    # build_no_leader): registered so a cfg can name it to exercise the
+    # violation/counterexample path, and part of the analyzer's
+    # conservative default predicate set like every other entry (its
+    # reads only make certificates MORE conservative).
+    return {"TypeOK": build_type_ok, "NoLeaderElected": build_no_leader,
+            **SAFETY_INVARIANTS}
 
 
 def checkable_predicates(dims: RaftDims, invariant_names=None,

@@ -102,6 +102,16 @@ def bag_reply(bag: Bag, response: Message, request: Message) -> Bag:
 
 # ---------------------------------------------------------------------------
 # Pretty-printing (for counterexample traces; mirrors TLC's state dumps).
+#
+# ONE formatter: ``state_fields`` is the canonical decoded view of a state
+# (JSON-able, per-server fields + the message bag), ``format_state`` and
+# the counterexample explainer (engine/explain.py) both render FROM it,
+# and ``diff_states`` computes changed-field deltas over the same keys —
+# so the oracle/debug printouts and the explainer can never drift apart.
+
+ROLE_LETTERS = {0: "F", 1: "C", 2: "L"}
+ROLE_NAMES = {0: "Follower", 1: "Candidate", 2: "Leader"}
+
 
 def format_message(m: Message, dims: RaftDims) -> str:
     t = m[0]
@@ -116,19 +126,71 @@ def format_message(m: Message, dims: RaftDims) -> str:
     return head + f" success={bool(m[4])} matchIndex={m[5]}"
 
 
+def state_fields(s: PyState, dims: RaftDims) -> dict:
+    """Canonical decoded view of one state: ``{"r<i>.<field>": value}``
+    per server plus the sorted message bag under ``"messages"`` —
+    JSON-able, and the shared substrate for ``format_state``,
+    ``diff_states``, and the counterexample explainer."""
+    n = dims.n_servers
+    out = {}
+    for i in range(n):
+        r = f"r{i+1}"
+        out[f"{r}.term"] = s.current_term[i]
+        out[f"{r}.role"] = ROLE_LETTERS.get(s.role[i], str(s.role[i]))
+        out[f"{r}.votedFor"] = ("Nil" if s.voted_for[i] == NIL
+                                else f"r{s.voted_for[i]}")
+        out[f"{r}.log"] = [list(e) for e in s.log[i]]
+        out[f"{r}.commitIndex"] = s.commit_index[i]
+        out[f"{r}.votesResponded"] = f"{s.votes_responded[i]:0{n}b}"
+        out[f"{r}.votesGranted"] = f"{s.votes_granted[i]:0{n}b}"
+        out[f"{r}.nextIndex"] = list(s.next_index[i])
+        out[f"{r}.matchIndex"] = list(s.match_index[i])
+    out["messages"] = [{"count": c, "msg": format_message(m, dims)}
+                       for m, c in sorted(s.messages)]
+    return out
+
+
+def diff_states(a: PyState, b: PyState, dims: RaftDims) -> dict:
+    """Changed fields ``a -> b`` as ``{key: [old, new]}`` over the
+    ``state_fields`` keys; the message bag diffs as added/removed
+    rendered messages.  The explainer's per-step "what this action
+    changed" column comes from exactly this."""
+    fa, fb = state_fields(a, dims), state_fields(b, dims)
+    out = {}
+    for k in fa:
+        if k == "messages":
+            continue
+        if fa[k] != fb[k]:
+            out[k] = [fa[k], fb[k]]
+    da = dict(a.messages)
+    db = dict(b.messages)
+    added = [f"{db[m] - da.get(m, 0)}x {format_message(m, dims)}"
+             for m in sorted(db) if db[m] > da.get(m, 0)]
+    removed = [f"{da[m] - db.get(m, 0)}x {format_message(m, dims)}"
+               for m in sorted(da) if da[m] > db.get(m, 0)]
+    if added:
+        out["messages.added"] = added
+    if removed:
+        out["messages.removed"] = removed
+    return out
+
+
 def format_state(s: PyState, dims: RaftDims) -> str:
     n = dims.n_servers
-    roles = {0: "F", 1: "C", 2: "L"}
+    f = state_fields(s, dims)
     lines = []
     for i in range(n):
-        vf = "Nil" if s.voted_for[i] == NIL else f"r{s.voted_for[i]}"
+        r = f"r{i+1}"
+        log = [tuple(e) for e in f[f"{r}.log"]]
         lines.append(
-            f"  r{i+1}: term={s.current_term[i]} role={roles[s.role[i]]}"
-            f" votedFor={vf} log={list(s.log[i])} commit={s.commit_index[i]}"
-            f" resp={s.votes_responded[i]:0{n}b} gran={s.votes_granted[i]:0{n}b}"
-            f" nextIndex={list(s.next_index[i])} matchIndex={list(s.match_index[i])}")
-    msgs = sorted(s.messages)
+            f"  {r}: term={f[f'{r}.term']} role={f[f'{r}.role']}"
+            f" votedFor={f[f'{r}.votedFor']} log={log}"
+            f" commit={f[f'{r}.commitIndex']}"
+            f" resp={f[f'{r}.votesResponded']} gran={f[f'{r}.votesGranted']}"
+            f" nextIndex={f[f'{r}.nextIndex']}"
+            f" matchIndex={f[f'{r}.matchIndex']}")
+    msgs = f["messages"]
     lines.append(f"  messages ({len(msgs)} distinct):")
-    for m, c in msgs:
-        lines.append(f"    {c}x {format_message(m, dims)}")
+    for m in msgs:
+        lines.append(f"    {m['count']}x {m['msg']}")
     return "\n".join(lines)

@@ -25,7 +25,16 @@ One spine, several legs:
 - :mod:`.expose` — Prometheus text exposition of the registry
   (``render_prometheus``/``parse_prometheus``) and the standalone
   ``--metrics-port`` HTTP listener (``/metrics`` + ``/flight``) behind
-  the ``watch`` run-attach console.
+  the ``watch`` run-attach console;
+- :mod:`.report` — the TLC-parity **statespace run report** (collision
+  probability, per-level frontier table, out-degree, seen-set load)
+  assembled host-side at run end: the ``statespace`` event,
+  ``EngineResult.report``, and the TLC-style stderr block;
+- :mod:`.history` — the append-only JSONL **run-history ledger**
+  (``check --history`` / ``HISTORY`` directive / ``BENCH_HISTORY``):
+  per-run cfg/model/host fingerprints, verdict, rates, and report
+  summary; ``scripts/bench_history.py`` renders the trajectory and
+  ``scripts/bench_diff.py --history`` resolves baselines from it.
 
 The CLI exposes them via ``--metrics-out`` / ``--events-out`` /
 ``--trace-out`` / ``--profile-chunks`` / ``--metrics-port`` /
@@ -47,6 +56,9 @@ from .flight import (FlightRecorder, RECORDER,                   # noqa: F401
                      host_fingerprint)
 from .expose import (parse_prometheus, render_prometheus,        # noqa: F401
                      serve_metrics, start_metrics_server)
+from .report import (build_report, collision_probability,        # noqa: F401
+                     render_report)
+from . import history                                            # noqa: F401
 # .profile imports jax lazily but pulls model/ops modules at call time;
 # import the classes here for the one-stop namespace (still jax-free at
 # import).

@@ -48,12 +48,17 @@ KNOWN_EVENTS = (
     "postmortem",       # a black-box dump was written; payload: "dump"
     "watch_attach",     # a live watcher attached; payload: "client"
     "xla_profile",      # device-profiler capture window; payload: "capture"
+    # Semantic-observability layer (obs/report.py): the TLC-parity
+    # statespace report, one per completed run.  ``run_end`` also gains
+    # ``counterexample_path`` when a traced violation was rendered
+    # (engine/explain.py).
+    "statespace",       # TLC-parity run report; payload: "report"
 )
 
 #: Structured payload field each new event type must carry.
 _EVENT_PAYLOAD_FIELDS = {"chunk_profile": "stages", "coverage": "actions",
                          "postmortem": "dump", "watch_attach": "client",
-                         "xla_profile": "capture"}
+                         "xla_profile": "capture", "statespace": "report"}
 
 
 #: memory_stats() keys kept in event payloads (one extraction for the

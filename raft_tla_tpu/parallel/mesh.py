@@ -565,6 +565,17 @@ class MeshBFSEngine:
         from ..engine.bfs import BFSEngine
         BFSEngine._emit_level_event(self, res, frontier_rows)
 
+    def _counterexample_base(self) -> str:
+        """Per-controller counterexample file stem (the event-log piece
+        model): under a process group every controller renders — each
+        merged its siblings' trace pieces at replay, so the contents
+        agree — but two controllers must never race one filename on the
+        shared filesystem.  Single-controller resolution is unchanged."""
+        if jax.process_count() <= 1:
+            return "counterexample"
+        return (f"counterexample.p{jax.process_index()}"
+                f"of{jax.process_count()}")
+
     def _run_impl(self, init_states: Optional[List[PyState]] = None,
                   resume=None) -> EngineResult:
         from ..engine import checkpoint as ckpt_mod
@@ -905,6 +916,13 @@ class MeshBFSEngine:
                     break
             level_rows = drained + cur_sum
             res.levels.append(level_rows)
+            # Seen gauges refreshed BEFORE the level-0 emit (engine/
+            # bfs.py rationale): its level_stats snapshot reads them,
+            # and a warm shared registry would otherwise leak the
+            # previous run's values into this run's level-0 row.  Same
+            # per-chip convention as the chunk loop's gauge updates.
+            mt.gauge("engine/seen_capacity", self._CL)
+            mt.gauge("engine/seen_size", int(ist[6]))
             self._emit_level_event(res, level_rows)
             qcur, qnext = qnext, qcur
             cur_counts_dev = next_counts

@@ -20,6 +20,8 @@ response per line.  Requests:
      "engine": "single" | "mesh"}
         -> {"ok": true, "distinct": N, "generated": N, "diameter": N,
             "levels": [...], "stop_reason": "...",
+            "report": {collision probability, per-level table,
+                       out-degree, seen-set load — obs/report.py},
             "violation": null | {"invariant": "...", "fingerprint": "0x..",
                                  "trace": [{"action": "...",
                                             "state": "..."}, ...]},
@@ -258,6 +260,11 @@ def _do_check(req):
            # object bench JSON carries; also mirrored as coverage/*
            # gauges in the "stats" op.
            "coverage": dict(res.coverage),
+           # TLC-parity statespace report (obs/report.py): collision
+           # probability, per-level table, out-degree, seen-set load.
+           # Also mirrored as statespace/* gauges in "stats", so the
+           # two surfaces can never disagree about the scalar spine.
+           "report": dict(res.report),
            "violation": None, "deadlock": None}
     if res.violation is not None:
         out["violation"] = _violation_json(engine, res.violation,

@@ -39,7 +39,12 @@ engine/bfs.py EngineConfig.pipeline), XLA_PROFILE (device-profiler
 capture: trace the first N chunk calls through jax.profiler,
 obs/profile.py XlaProfileCapture), METRICS_PORT (serve /metrics
 Prometheus exposition + /flight live snapshots over HTTP for the run,
-obs/expose.py).
+obs/expose.py), REPORT (the TLC-parity statespace run report,
+obs/report.py; TRUE by default — FALSE drops every report surface),
+COUNTEREXAMPLE_DIR (where a traced violation's rendered counterexample
+lands, engine/explain.py; defaults next to CHECKPOINT_DIR), HISTORY
+(append one run-history ledger entry per run to this JSONL file,
+obs/history.py).
 Precedence everywhere: CLI flag > cfg backend key > built-in default.
 """
 
@@ -89,7 +94,8 @@ _BACKEND_KEYS = {
     "PLATFORM", "CHECKPOINT_DIR", "CHECKPOINT_EVERY", "CHECKPOINT_INTERVAL",
     "SPILL_DIR", "TRACE_DIR", "PROGRESS_SECONDS", "EVENTS_OUT",
     "KEEP_CHECKPOINTS", "TRACE_OUT", "PROFILE_CHUNKS", "POR", "POR_TABLE",
-    "PIPELINE", "XLA_PROFILE", "METRICS_PORT",
+    "PIPELINE", "XLA_PROFILE", "METRICS_PORT", "REPORT",
+    "COUNTEREXAMPLE_DIR", "HISTORY",
 }
 
 

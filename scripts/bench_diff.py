@@ -55,7 +55,10 @@ fails loudly, not silently green).
 
 import argparse
 import json
+import os
 import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 PHASE_PREFIX_SKIP = ("profile",)   # measurement overhead, not engine work
 
@@ -109,8 +112,10 @@ class Diff:
 
 #: host_fingerprint keys that make absolute rates incomparable when
 #: they differ (hostname alone does not: same container class, new pod).
-_FINGERPRINT_KEYS = ("cpu_model", "device_kind", "device_count",
-                     "platform", "jax", "jaxlib")
+#: ONE definition, shared with the run ledger's host_key
+#: (obs/history.py) — the cross-host WARNING here and resolve_baseline's
+#: same-host matching must never disagree about what "same host" means.
+from raft_tla_tpu.obs.history import HOST_KEYS as _FINGERPRINT_KEYS  # noqa: E402
 
 
 def diff_host(old: dict, new: dict, d: Diff):
@@ -276,12 +281,54 @@ def diff_coverage(old: dict, new: dict, d: Diff, drift_pts: float):
                    f"of generated")
 
 
+def resolve_history_baseline(ledger: str, new: dict):
+    """``--history``: the baseline is the newest ledger entry whose
+    host key matches the candidate's host fingerprint (obs/history.py
+    resolve_baseline) — never a cross-host number.  Returns (bench
+    dict, describing label); raises ValueError when it cannot resolve
+    (no fingerprint on the candidate, no same-host entry, unreadable
+    ledger) — exit 2, the cannot-read-evidence convention."""
+    from raft_tla_tpu.obs import history as history_mod
+    fp = new.get("host_fingerprint")
+    if not history_mod.host_key(fp):
+        raise ValueError(
+            "--history needs the candidate bench to embed a "
+            "host_fingerprint (bench.py emits one; legacy files do "
+            "not) — without it a same-host baseline cannot be chosen")
+    try:
+        # exclude_bench=new: the candidate's own ledger line (the
+        # documented record-then-gate workflow appends it first) must
+        # never be chosen — a self-compare gate is vacuously green.
+        entry = history_mod.resolve_baseline(ledger, fp,
+                                             exclude_bench=new)
+    except (OSError, ValueError) as e:
+        raise ValueError(f"cannot read ledger {ledger}: {e}")
+    if entry is None:
+        raise ValueError(
+            f"{ledger}: no bench entry with host key "
+            f"{history_mod.host_key(fp)} other than the candidate "
+            f"itself — run a bench with BENCH_HISTORY on this host "
+            f"first (cross-host baselines must be picked explicitly, "
+            f"never auto-resolved)")
+    label = entry.get("label") or f"ts {entry.get('ts')}"
+    return entry["bench"], f"history:{label}"
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(
         description="diff two bench JSONs; nonzero exit on regression")
-    p.add_argument("old", help="baseline bench JSON (raw or BENCH_r* "
-                               "wrapper)")
-    p.add_argument("new", help="candidate bench JSON")
+    p.add_argument("old", nargs="?", default=None,
+                   help="baseline bench JSON (raw or BENCH_r* wrapper); "
+                        "omit with --history to auto-resolve it from "
+                        "the run ledger")
+    p.add_argument("new", nargs="?", default=None,
+                   help="candidate bench JSON")
+    p.add_argument("--history", default=None, metavar="LEDGER",
+                   help="resolve the baseline from this run-history "
+                        "ledger (obs/history.py): the newest bench "
+                        "entry with the SAME host fingerprint as the "
+                        "candidate.  Usage: bench_diff.py --history "
+                        "LEDGER new.json")
     p.add_argument("--max-regress", type=float, default=0.10,
                    help="allowed fractional drop in headline rates "
                         "(default 0.10 = 10%%)")
@@ -302,12 +349,30 @@ def main(argv=None) -> int:
     args = p.parse_args(argv)
 
     try:
-        old, new = load_bench(args.old), load_bench(args.new)
+        if args.history is not None:
+            # One positional: the candidate (argparse fills `old`
+            # first, so accept either slot).
+            new_path = args.new or args.old
+            if new_path is None or (args.new and args.old):
+                raise ValueError(
+                    "--history takes exactly one bench JSON (the "
+                    "candidate); the baseline comes from the ledger")
+            new = load_bench(new_path)
+            old, old_label = resolve_history_baseline(args.history, new)
+        else:
+            if args.old is None or args.new is None:
+                raise ValueError("need OLD and NEW bench JSONs "
+                                 "(or --history LEDGER NEW)")
+            old, new = load_bench(args.old), load_bench(args.new)
+            old_label, new_path = args.old, args.new
     except ValueError as e:
         print(f"bench_diff: {e}", file=sys.stderr)
         return 2
 
-    print(f"bench_diff: {args.old} -> {args.new}")
+    print(f"bench_diff: {old_label} -> {new_path}")
+    if args.history is not None:
+        print(f"  baseline auto-resolved from history ledger "
+              f"{args.history} ({old_label})")
     d = Diff()
     diff_host(old, new, d)
     diff_headline(old, new, d, args.max_regress)
