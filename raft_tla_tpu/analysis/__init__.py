@@ -5,10 +5,12 @@ packed ``StateBatch`` encoding, so the *model itself* is analyzable at
 trace time.  Four passes share one jaxpr evaluator (``interp.py``) and
 one findings/report spine (``report.py``):
 
-- :mod:`.effects` — per-action read/write sets from the kernel jaxprs:
-  the action dependence matrix (which instances provably commute — the
-  fact partial-order reduction and BLEST-style tensor-core batching
-  need), guard-independence, and dead packed lanes;
+- :mod:`.effects` — per-action ELEMENT-WISE (slot/column-granular)
+  read/write masks from the kernel jaxprs: the element-granular action
+  dependence matrix (which instances provably commute — the fact
+  partial-order reduction and BLEST-style tensor-core batching need),
+  guard-independence, dead packed lanes, and the versioned footprint
+  serialization downstream tooling decodes instead of re-tracing;
 - :mod:`.bounds` — interval abstract interpretation of every kernel to
   a reachable-envelope fixpoint: proves each packed lane wide enough
   (or names the witness action that overflows it) and flags int32 wrap,
@@ -20,9 +22,12 @@ one findings/report spine (``report.py``):
   that the host chunk loop only blocks on device data at sanctioned
   sync points, plus an analyzer-vs-analyzer read-set self-check;
 - :mod:`.por` — static partial-order reduction: per-instance ample-set
-  certificates proved from the effects matrices (closure, invariant
-  visibility, cycle proviso), packed into the device-consumable
-  reduction table ``EngineConfig.por`` applies in the expansion stage.
+  certificates proved from the element-wise effects matrices (closure,
+  invariant visibility, cycle proviso), packed into the
+  device-consumable reduction table ``EngineConfig.por`` applies in
+  the expansion stage; closure blocks are classified by a concrete
+  non-commutation witness search (machine-checked impossibility vs
+  precision worklist).
 
 ``run_analysis`` executes the passes and aggregates one
 :class:`~.report.Report`; the ``analyze`` CLI subcommand and the CI
@@ -40,6 +45,34 @@ from .report import ERROR, INFO, Report, WARNING  # noqa: F401
 #: Pass registry, in execution order.
 PASSES = ("effects", "bounds", "lint", "por")
 
+#: Inter-pass data dependencies: ``lint``'s read-set self-check and
+#: ``por``'s certificates consume the effects pass's live summary.
+#: ``resolve_passes`` inserts prerequisites automatically so a user can
+#: run ``analyze --passes por`` without spelling out the pipeline.
+PASS_DEPS = {"lint": ("effects",), "por": ("effects",)}
+
+
+def resolve_passes(requested) -> tuple:
+    """Close the requested pass list under :data:`PASS_DEPS` and return
+    it in registry (topological) order.  Unknown names raise — a typo
+    must never produce a silent no-op run."""
+    requested = tuple(requested)
+    unknown = [p for p in requested if p not in PASSES]
+    if unknown or not requested:
+        raise ValueError(
+            f"unknown analysis pass(es) "
+            f"{', '.join(unknown) or '(none given)'}; registered: "
+            f"{', '.join(PASSES)}")
+    want = set(requested)
+    # PASS_DEPS is one level deep today; iterate to a fixpoint anyway so
+    # a deeper chain added later cannot silently under-resolve.
+    while True:
+        more = {d for p in want for d in PASS_DEPS.get(p, ())} - want
+        if not more:
+            break
+        want |= more
+    return tuple(p for p in PASSES if p in want)
+
 
 def run_analysis(dims, bounds=None, init_states=None,
                  passes=PASSES, allowlist: Optional[List[str]] = None,
@@ -48,13 +81,17 @@ def run_analysis(dims, bounds=None, init_states=None,
     """Run the requested passes over one model.
 
     ``bounds`` is the cfg's CONSTRAINT bounds (models/invariants.Bounds),
-    ``init_states`` concrete roots to seed the bounds fixpoint (None or
-    randomized-smoke roots fall back to the declared domain envelope),
-    ``lane_caps``/``lint_targets`` are test/fixture overrides passed to
-    their passes, ``invariant_names`` the cfg's INVARIANT list for the
-    POR visibility condition (None = the conservative full registry).
-    ``metrics`` (MetricsRegistry) and ``evlog`` (RunEventLog) receive
-    the per-pass telemetry when given."""
+    ``init_states`` concrete roots to seed the bounds fixpoint and the
+    POR closure-refutation probe pool (None or randomized-smoke roots
+    fall back to the declared domain envelope / the model's probe
+    states), ``lane_caps``/``lint_targets`` are test/fixture overrides
+    passed to their passes, ``invariant_names`` the cfg's INVARIANT
+    list for the POR visibility condition (None = the conservative full
+    registry).  ``passes`` is closed under :data:`PASS_DEPS` — asking
+    for ``por`` alone runs ``effects`` first.  ``metrics``
+    (MetricsRegistry) and ``evlog`` (RunEventLog) receive the per-pass
+    telemetry when given."""
+    passes = resolve_passes(passes)
     report = Report(model={"dims": repr(dims),
                            "model_class": type(dims).__name__},
                     allowlist=allowlist)
@@ -80,7 +117,7 @@ def run_analysis(dims, bounds=None, init_states=None,
             from . import por
             summary, findings = por.analyze(
                 dims, bounds=bounds, invariant_names=invariant_names,
-                effect_summary=eff_summary)
+                effect_summary=eff_summary, init_states=init_states)
         else:
             raise ValueError(f"unknown analysis pass {name!r}; "
                              f"registered: {PASSES}")

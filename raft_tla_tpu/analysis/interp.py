@@ -6,12 +6,20 @@ space: trace each family once to a jaxpr, then re-evaluate that jaxpr
 under an abstract domain instead of on device.  Two domains share one
 evaluator:
 
-- :class:`TaintDomain` (effects pass): each value carries the set of
-  ``StateBatch`` fields it may depend on (``deps``), an element-wise
-  "may differ from input field F at this position" mask (``origin`` /
-  ``diff``), and a partial concrete evaluation (``known``/``vals``) so
+- :class:`TaintDomain` (effects pass): each value carries ELEMENT-WISE
+  dependency masks per ``StateBatch`` field, split into a value-level
+  half (``vdeps`` — any element may depend on the masked field
+  elements) and a positional half (``pdeps`` — element ``p`` depends on
+  the field only through element ``p``), an element-wise "may differ
+  from input field F at this position" mask (``origin`` / ``diff``),
+  and a partial concrete evaluation (``known``/``vals``) so
   parameter-derived index masks like ``arange(N) == i`` stay exact and
-  writes stay confined to the instance's own lanes.
+  writes stay confined to the instance's own lanes.  Indexed accesses
+  with parameter-concrete indices touch exactly their window; a
+  state-dependent index component widens only its own axis.  The
+  positional/value split is what makes point updates read only their
+  own row: intersecting ``pdeps`` with a write's changed positions
+  discards the identity pass-through.
 - :class:`IntervalDomain` (bounds pass): each value is an element-wise
   integer interval ``[lo, hi]`` in int64, so packed-lane bounds and
   int32 wrap are decided by monotone transfer functions; parameters and
@@ -541,12 +549,44 @@ class IntervalDomain:
 
 _EMPTY: FrozenSet[str] = frozenset()
 
+#: Element-wise dependency footprint: field name -> bool mask over THAT
+#: FIELD's shape.  Masks are treated as immutable (never updated in
+#: place), so dictionaries may share arrays freely.
+Deps = Dict[str, np.ndarray]
+
+
+def _dunion(*dicts: Deps) -> Deps:
+    """Key-wise OR of dependency footprints."""
+    out: Deps = {}
+    for d in dicts:
+        for f, m in d.items():
+            prev = out.get(f)
+            out[f] = m if prev is None else (prev | m)
+    return out
+
+
+def read_mask(t: "Taint") -> Deps:
+    """The value's full element-wise read set (value-level join of the
+    positional and value-level halves)."""
+    return _dunion(t.vdeps, t.pdeps)
+
 
 @dataclasses.dataclass
 class Taint:
     """Element-wise dependency/identity abstraction.
 
-    - ``deps``: input fields this value may depend on (whole-array).
+    Dependencies are tracked per input-field ELEMENT, split in two:
+
+    - ``vdeps[f]`` — value-level: ANY element of this value may depend
+      on the masked elements of field ``f``.
+    - ``pdeps[f]`` — positional: element ``p`` of this value may depend
+      on field ``f`` only through ``f[p]`` (the mask marks which
+      positions).  Only meaningful while the value's shape equals the
+      field's shape; every shape-changing primitive graduates the
+      positional half into ``vdeps`` (conservative).  This is what lets
+      a point update like ``where(arange(N) == i, term + 1, term)``
+      read only ``term[i]`` instead of the whole field: the changed
+      positions (``diff``) intersect the positional mask.
     - ``origin``/``diff``: if ``origin`` is field F, elements where
       ``diff`` is False are *provably equal to input field F at the
       same position* — the write-set extractor reads successor fields'
@@ -557,7 +597,8 @@ class Taint:
       confined to the instance's own rows.
     """
 
-    deps: FrozenSet[str]
+    vdeps: Deps
+    pdeps: Deps
     origin: Optional[str]
     diff: np.ndarray          # bool, value shape
     known: np.ndarray         # bool, value shape
@@ -568,30 +609,40 @@ class Taint:
     def shape(self):
         return self.diff.shape
 
+    @property
+    def deps(self) -> FrozenSet[str]:
+        """Field-level view of the read set (compat / summaries)."""
+        return frozenset(set(self.vdeps) | set(self.pdeps))
 
-def _taint(deps, origin, diff, known, vals, dtype) -> Taint:
+
+def _taint(vdeps, pdeps, origin, diff, known, vals, dtype) -> Taint:
     diff = np.asarray(diff, bool)
     known = np.asarray(known, bool)
     vals = np.asarray(vals, _I64)
     diff, known, vals = np.broadcast_arrays(diff, known, vals)
     if known.all():
-        deps, origin = _EMPTY, None
-    return Taint(frozenset(deps), origin, np.array(diff), np.array(known),
+        vdeps, pdeps, origin = {}, {}, None
+    vdeps = {f: m for f, m in vdeps.items() if m.any()}
+    pdeps = {f: m for f, m in pdeps.items() if m.any()}
+    return Taint(vdeps, pdeps, origin, np.array(diff), np.array(known),
                  np.array(vals), np.dtype(dtype))
 
 
-def _opaque(deps, shape, dtype) -> Taint:
-    """Depends on ``deps``, nothing known element-wise."""
+def _opaque(vdeps, shape, dtype) -> Taint:
+    """Depends (value-level) on ``vdeps``, nothing known element-wise."""
     z = np.zeros(shape, bool)
-    return _taint(deps, None, ~z, z, np.zeros(shape, _I64), dtype)
+    return _taint(vdeps, {}, None, ~z, z, np.zeros(shape, _I64), dtype)
 
 
 class TaintDomain:
     """Transfer functions for dependency/identity extraction.  The only
-    precision that matters downstream: (1) ``deps`` never loses a real
-    dependency, (2) ``diff`` is True wherever the element can differ
-    from its origin field, (3) parameter-concrete index arithmetic stays
-    ``known`` so per-instance write masks are lane-accurate."""
+    precision that matters downstream: (1) ``vdeps``/``pdeps`` never
+    lose a real dependency, (2) ``diff`` is True wherever the element
+    can differ from its origin field, (3) parameter-concrete index
+    arithmetic stays ``known`` so per-instance write masks are
+    lane-accurate, (4) the positional half is claimed only through
+    shape-preserving element-wise flows, so intersecting it with a
+    write's ``diff`` mask yields a sound slot-precise read set."""
 
     #: numpy implementations for the concrete (known) path.
     _NP = {
@@ -614,15 +665,14 @@ class TaintDomain:
         if isinstance(x, Taint):
             return x
         arr = np.asarray(x)
-        return _taint(_EMPTY, None, np.ones(arr.shape, bool),
+        return _taint({}, {}, None, np.ones(arr.shape, bool),
                       np.ones(arr.shape, bool), arr.astype(_I64), arr.dtype)
 
     def unknown(self, aval, invals, why: str) -> Taint:
         if why not in self.notes:
             self.notes.append(why)
-        deps = frozenset().union(*(v.deps for v in invals)) \
-            if invals else _EMPTY
-        return _opaque(deps, aval.shape, aval.dtype)
+        vdeps = _dunion(*(read_mask(v) for v in invals)) if invals else {}
+        return _opaque(vdeps, aval.shape, aval.dtype)
 
     def apply(self, name: str, eqn, invals):
         if name in self._NP and len(invals) <= 2:
@@ -632,6 +682,21 @@ class TaintDomain:
             return [self.unknown(v.aval, invals, f"primitive:{name}")
                     for v in eqn.outvars]
         return rule(eqn, *invals)
+
+    def _join_deps(self, shape, invals) -> Tuple[Deps, Deps]:
+        """(vdeps, pdeps) of an element-wise combination: an input of
+        the output's shape keeps its positional half; a broadcast input
+        graduates it to value-level (element p of the output no longer
+        maps to element p of the field)."""
+        vd: List[Deps] = []
+        pd: List[Deps] = []
+        for v in invals:
+            if v.shape == shape:
+                vd.append(v.vdeps)
+                pd.append(v.pdeps)
+            else:
+                vd.append(read_mask(v))
+        return _dunion(*vd), _dunion(*pd)
 
     # -- elementwise with partial evaluation ---------------------------
     def _elementwise(self, eqn, name, invals):
@@ -656,8 +721,8 @@ class TaintDomain:
         out_vals = np.asarray(out_vals)
         if np.dtype(aval.dtype) == np.bool_:
             out_vals = out_vals.astype(bool)
-        deps = frozenset().union(*(v.deps for v in invals))
-        return _taint(deps, None, np.ones(shape, bool), known,
+        vdeps, pdeps = self._join_deps(shape, invals)
+        return _taint(vdeps, pdeps, None, np.ones(shape, bool), known,
                       out_vals.astype(_I64), aval.dtype)
 
     # -- selection -----------------------------------------------------
@@ -677,10 +742,8 @@ class TaintDomain:
             vals = np.where(sel, case_vals[k], vals)
             used[k] = bool(np.any(sel)) or not pk.all()
         # deps: predicate plus every case that can be selected somewhere.
-        deps = set(pred.deps)
-        for k, c in enumerate(cases):
-            if used[k]:
-                deps |= c.deps
+        vdeps, pdeps = self._join_deps(
+            shape, [pred] + [c for k, c in enumerate(cases) if used[k]])
         # origin/diff: keep identity only when exactly one input field
         # appears as a case origin.
         origins = {c.origin for c in cases if c.origin is not None}
@@ -694,7 +757,7 @@ class TaintDomain:
             diff = np.where(pk, chosen, np.logical_or.reduce(diffs))
         else:
             origin, diff = None, np.ones(shape, bool)
-        return _taint(deps, origin, diff, known, vals, aval.dtype)
+        return _taint(vdeps, pdeps, origin, diff, known, vals, aval.dtype)
 
     # -- structure -----------------------------------------------------
     def _p_broadcast_in_dim(self, eqn, a):
@@ -710,30 +773,35 @@ class TaintDomain:
         origin = a.origin if same else None
         diff = np.broadcast_to(a.diff.reshape(mid), shape) if same \
             else np.ones(shape, bool)
-        return _taint(a.deps, origin, diff, known, vals, aval.dtype)
+        vdeps = a.vdeps if same else read_mask(a)
+        pdeps = a.pdeps if same else {}
+        return _taint(vdeps, pdeps, origin, diff, known, vals, aval.dtype)
 
     def _p_reshape(self, eqn, a):
         shape = tuple(eqn.params["new_sizes"])
-        return _taint(a.deps, a.origin, a.diff.reshape(shape),
+        same = shape == a.shape
+        return _taint(a.vdeps if same else read_mask(a),
+                      a.pdeps if same else {},
+                      a.origin, a.diff.reshape(shape),
                       a.known.reshape(shape), a.vals.reshape(shape),
                       _out_aval(eqn).dtype)
 
     def _p_squeeze(self, eqn, a):
         shape = _out_aval(eqn).shape
-        return _taint(a.deps, None, np.ones(shape, bool),
+        return _taint(read_mask(a), {}, None, np.ones(shape, bool),
                       a.known.reshape(shape), a.vals.reshape(shape),
                       _out_aval(eqn).dtype)
 
     def _p_expand_dims(self, eqn, a):
         shape = _out_aval(eqn).shape
-        return _taint(a.deps, None, np.ones(shape, bool),
+        return _taint(read_mask(a), {}, None, np.ones(shape, bool),
                       a.known.reshape(shape), a.vals.reshape(shape),
                       _out_aval(eqn).dtype)
 
     def _p_concatenate(self, eqn, *parts):
         d = eqn.params["dimension"]
-        deps = frozenset().union(*(p.deps for p in parts))
-        return _taint(deps, None,
+        vdeps = _dunion(*(read_mask(p) for p in parts))
+        return _taint(vdeps, {}, None,
                       np.ones(_out_aval(eqn).shape, bool),
                       np.concatenate([p.known for p in parts], axis=d),
                       np.concatenate([p.vals for p in parts], axis=d),
@@ -743,7 +811,12 @@ class TaintDomain:
         idx = tuple(slice(s, l, st or 1) for s, l, st in zip(
             eqn.params["start_indices"], eqn.params["limit_indices"],
             eqn.params["strides"] or [1] * len(eqn.params["start_indices"])))
-        return _taint(a.deps, None, np.ones(_out_aval(eqn).shape, bool),
+        # The untouched positional region is not read through this value.
+        region = np.zeros(a.shape, bool)
+        region[idx] = True
+        vdeps = _dunion(a.vdeps, {f: m & region for f, m in a.pdeps.items()})
+        return _taint(vdeps, {}, None,
+                      np.ones(_out_aval(eqn).shape, bool),
                       a.known[idx], a.vals[idx], _out_aval(eqn).dtype)
 
     def _p_iota(self, eqn):
@@ -759,7 +832,8 @@ class TaintDomain:
         dtype = np.dtype(_out_aval(eqn).dtype)
         vals = a.vals.astype(bool).astype(_I64) if dtype == np.bool_ \
             else a.vals
-        return _taint(a.deps, a.origin, a.diff, a.known, vals, dtype)
+        return _taint(a.vdeps, a.pdeps, a.origin, a.diff, a.known, vals,
+                      dtype)
 
     def _p_stop_gradient(self, eqn, a):
         return a
@@ -769,13 +843,15 @@ class TaintDomain:
 
     def _p_transpose(self, eqn, a):
         perm = tuple(eqn.params["permutation"])
-        return _taint(a.deps, None, np.ones(_out_aval(eqn).shape, bool),
+        return _taint(read_mask(a), {}, None,
+                      np.ones(_out_aval(eqn).shape, bool),
                       np.transpose(a.known, perm),
                       np.transpose(a.vals, perm), _out_aval(eqn).dtype)
 
     def _p_rev(self, eqn, a):
         dims = tuple(eqn.params["dimensions"])
-        return _taint(a.deps, None, np.ones(_out_aval(eqn).shape, bool),
+        return _taint(read_mask(a), {}, None,
+                      np.ones(_out_aval(eqn).shape, bool),
                       np.flip(a.known, dims), np.flip(a.vals, dims),
                       _out_aval(eqn).dtype)
 
@@ -790,7 +866,7 @@ class TaintDomain:
             out = np.asarray(self._REDUCE[name](a.vals,
                                                 axis=_axes(eqn.params)))
             return self.lift(out.astype(aval.dtype))
-        return _opaque(a.deps, aval.shape, aval.dtype)
+        return _opaque(read_mask(a), aval.shape, aval.dtype)
 
     def _p_reduce_sum(self, eqn, a):
         return self._reduce(eqn, a, "reduce_sum")
@@ -821,7 +897,7 @@ class TaintDomain:
         if a.known.all():
             out = np.asarray(fn(a.vals, axis=tuple(eqn.params["axes"])[0]))
             return self.lift(out.astype(aval.dtype))
-        return _opaque(a.deps, aval.shape, aval.dtype)
+        return _opaque(read_mask(a), aval.shape, aval.dtype)
 
     def _p_clamp(self, eqn, lo_b, x, hi_b):
         aval = _out_aval(eqn)
@@ -830,27 +906,182 @@ class TaintDomain:
         vals = np.clip(np.broadcast_to(x.vals, aval.shape),
                        np.broadcast_to(lo_b.vals, aval.shape),
                        np.broadcast_to(hi_b.vals, aval.shape))
-        deps = lo_b.deps | x.deps | hi_b.deps
-        return _taint(deps, None, np.ones(aval.shape, bool), known, vals,
-                      aval.dtype)
+        vdeps, pdeps = self._join_deps(aval.shape, (lo_b, x, hi_b))
+        return _taint(vdeps, pdeps, None, np.ones(aval.shape, bool), known,
+                      vals, aval.dtype)
 
-    # -- indexed access (conservative) ---------------------------------
-    def _indexed(self, eqn, invals):
-        aval = _out_aval(eqn)
-        deps = frozenset().union(*(v.deps for v in invals))
-        return _opaque(deps, aval.shape, aval.dtype)
+    # -- indexed access (element-precise where the indices are) --------
+    #
+    # These are the rules that turn whole-field footprints into
+    # slot/column-granular ones: an access whose index components are
+    # parameter-concrete touches exactly the indexed window; a
+    # state-dependent component widens ONLY its own axis to the full
+    # dimension.  The widening stays per-element — the touched region is
+    # intersected with the operand's positional mask, so e.g.
+    # ``st.msg[s]`` with a concrete slot parameter reads row ``s`` only,
+    # while ``st.term[mdest]`` with a message-dependent index reads the
+    # whole ``term`` field (genuine, not an analyzer artifact).
+
+    @staticmethod
+    def _index_region(operand_shape, indexed_axes, slice_sizes,
+                      idx_known, idx_vals) -> np.ndarray:
+        """Bool mask over the operand of positions the access may touch.
+        ``indexed_axes`` maps index-vector component -> operand axis;
+        ``idx_known``/``idx_vals`` are [B, k] (B index rows)."""
+        comp = {ax: c for c, ax in enumerate(indexed_axes)}
+        axis_masks = []
+        for ax, dim in enumerate(operand_shape):
+            size = slice_sizes[ax]
+            m = np.zeros(dim, bool)
+            c = comp.get(ax)
+            if c is None:
+                m[:size] = True
+            elif bool(idx_known[:, c].all()):
+                for s in np.unique(np.clip(idx_vals[:, c], 0,
+                                           max(dim - size, 0))):
+                    m[int(s):int(s) + size] = True
+            else:
+                m[:] = True
+            axis_masks.append(m)
+        region = axis_masks[0]
+        for m in axis_masks[1:]:
+            region = region[..., None] & m
+        return region
+
+    @staticmethod
+    def _flat_indices(indices) -> Tuple[np.ndarray, np.ndarray]:
+        if indices.vals.ndim:
+            k = indices.vals.shape[-1]
+            return (indices.known.reshape(-1, k),
+                    indices.vals.reshape(-1, k))
+        return indices.known.reshape(1, 1), indices.vals.reshape(1, 1)
+
+    def _read_through(self, operand, region) -> Deps:
+        """Element-wise read set of an access touching ``region`` of
+        ``operand``: the positional half is restricted to the touched
+        positions, the value-level half cannot be."""
+        return _dunion(operand.vdeps,
+                       {f: m & region for f, m in operand.pdeps.items()})
+
+    @staticmethod
+    def _bind_concrete(eqn, *arrays):
+        """Evaluate the eqn's primitive eagerly on concrete numpy
+        arrays (used to push partially-``known`` values through indexed
+        access: the gather of a known mask is the output's known
+        mask)."""
+        import jax.numpy as jnp
+        out = eqn.primitive.bind(*(jnp.asarray(a) for a in arrays),
+                                 **eqn.params)
+        return np.asarray(out)
 
     def _p_gather(self, eqn, operand, indices):
-        return self._indexed(eqn, (operand, indices))
-
-    def _p_scatter(self, eqn, operand, indices, updates):
-        return self._indexed(eqn, (operand, indices, updates))
+        aval = _out_aval(eqn)
+        dn = eqn.params["dimension_numbers"]
+        ik, iv = self._flat_indices(indices)
+        region = self._index_region(
+            operand.shape, tuple(dn.start_index_map),
+            tuple(eqn.params["slice_sizes"]), ik, iv)
+        vdeps = _dunion(read_mask(indices),
+                        self._read_through(operand, region))
+        if bool(indices.known.all()) and bool(operand.known.any()):
+            known = self._bind_concrete(eqn, operand.known, indices.vals)
+            vals = self._bind_concrete(eqn, operand.vals, indices.vals)
+            return _taint(vdeps, {}, None, np.ones(aval.shape, bool),
+                          known, vals, aval.dtype)
+        return _opaque(vdeps, aval.shape, aval.dtype)
 
     def _p_dynamic_slice(self, eqn, operand, *starts):
-        return self._indexed(eqn, (operand,) + starts)
+        aval = _out_aval(eqn)
+        ik = np.array([[bool(s.known.all()) for s in starts]])
+        iv = np.array([[int(s.vals.reshape(-1)[0]) for s in starts]],
+                      _I64)
+        region = self._index_region(
+            operand.shape, tuple(range(operand.vals.ndim)),
+            tuple(eqn.params["slice_sizes"]), ik, iv)
+        vdeps = _dunion(self._read_through(operand, region),
+                        *(read_mask(s) for s in starts))
+        if bool(ik.all()) and bool(operand.known.any()):
+            svals = [s.vals.reshape(()) for s in starts]
+            known = self._bind_concrete(eqn, operand.known, *svals)
+            vals = self._bind_concrete(eqn, operand.vals, *svals)
+            return _taint(vdeps, {}, None, np.ones(aval.shape, bool),
+                          known, vals, aval.dtype)
+        return _opaque(vdeps, aval.shape, aval.dtype)
 
     def _p_dynamic_update_slice(self, eqn, operand, update, *starts):
-        return self._indexed(eqn, (operand, update) + starts)
+        aval = _out_aval(eqn)
+        exact = all(bool(s.known.all()) for s in starts)
+        if exact:
+            pos = []
+            for ax, st in enumerate(starts):
+                dim = operand.shape[ax]
+                size = update.shape[ax]
+                p = int(np.clip(int(st.vals.reshape(-1)[0]), 0,
+                                dim - size))
+                pos.append(slice(p, p + size))
+            region = np.zeros(operand.shape, bool)
+            region[tuple(pos)] = True
+            known = operand.known & ~region
+            vals = operand.vals.copy()
+            known = known.copy()
+            known[tuple(pos)] = update.known
+            vals[tuple(pos)] = update.vals
+        else:
+            region = np.ones(operand.shape, bool)
+            known = np.zeros(operand.shape, bool)
+            vals = np.zeros(operand.shape, _I64)
+        vdeps = _dunion(operand.vdeps, read_mask(update),
+                        *(read_mask(s) for s in starts))
+        # Outside the (possibly unknown) window the operand flows
+        # through positionally; inside it only where the window is
+        # exact does the operand element stop mattering.
+        pdeps = {f: (m & ~region if exact else m)
+                 for f, m in operand.pdeps.items()}
+        diff = operand.diff | region
+        return _taint(vdeps, pdeps, operand.origin, diff, known, vals,
+                      aval.dtype)
+
+    def _p_scatter(self, eqn, operand, indices, updates):
+        aval = _out_aval(eqn)
+        dn = eqn.params["dimension_numbers"]
+        ik, iv = self._flat_indices(indices)
+        full = len(dn.scatter_dims_to_operand_dims) == operand.vals.ndim
+        # "Exact" requires concrete IN-BOUNDS unique positions: an
+        # out-of-bounds update is dropped by XLA (mode-dependent), so a
+        # clipped position would both record a wrong known value and
+        # unsoundly clear the positional dep of the untouched element.
+        exact = full and bool(ik.all()) \
+            and updates.vals.size == ik.shape[0]
+        if exact:
+            pos = [tuple(int(iv[r, c]) for c in range(iv.shape[1]))
+                   for r in range(iv.shape[0])]
+            exact = len(set(pos)) == len(pos) and all(
+                0 <= p[c] < operand.shape[c]
+                for p in pos for c in range(len(p)))
+        if exact:
+            region = np.zeros(operand.shape, bool)
+            for p in pos:
+                region[p] = True
+            # Concrete semantics via the primitive itself — the known
+            # mask and values are scattered exactly the way XLA would.
+            known = self._bind_concrete(eqn, operand.known, indices.vals,
+                                        updates.known)
+            vals = self._bind_concrete(eqn, operand.vals, indices.vals,
+                                       updates.vals)
+        else:
+            region = self._index_region(
+                operand.shape, tuple(dn.scatter_dims_to_operand_dims),
+                tuple(1 if full else d for d in operand.shape), ik, iv) \
+                if full else np.ones(operand.shape, bool)
+            known = operand.known & ~region
+            vals = operand.vals
+        vdeps = _dunion(operand.vdeps, read_mask(indices),
+                        read_mask(updates))
+        pdeps = {f: m & ~region for f, m in operand.pdeps.items()} \
+            if exact else dict(operand.pdeps)
+        diff = operand.diff | region
+        return _taint(vdeps, pdeps, operand.origin, diff, known, vals,
+                      aval.dtype)
 
 
 # ---------------------------------------------------------------------------

@@ -300,17 +300,53 @@ def read_set_check(dims, family_reads=None,
     """Flag any action kernel whose jaxpr reads a packed lane outside
     the read set the effects pass reports for it.  ``family_reads``
     overrides the effects-derived ``{family: fields}`` map (tests plant
-    a missing field there to prove the check fires)."""
+    a missing field there to prove the check fires).
+
+    Element granularity: the effects pass now reports per-element
+    masks, so a FIELD the pass claims to read with an all-empty mask
+    would slip past a set-membership comparison — membership here is
+    therefore derived from the per-instance masks (``.any()``), and two
+    mask-level invariants of the extraction are re-checked per
+    instance: the guard's read mask is contained in the full read mask,
+    and every reported mask has the field's declared shape (a
+    wrong-shaped mask would make every element-wise intersection
+    downstream silently wrong)."""
     from . import lane_map
     from .interp import traced_kernels
+    findings: List[Finding] = []
     if family_reads is None:
         if effect_summary is None:
             from . import effects
             effect_summary, _f = effects.analyze(dims)
-        family_reads = {
-            fam: d["reads"] | d["guard_reads"]
-            for fam, d in effect_summary.families.items()}
-    findings: List[Finding] = []
+        shapes = lane_map.field_shapes(dims)
+        family_reads = {}
+        for inst in effect_summary.instances:
+            fam = family_reads.setdefault(inst.family, set())
+            fam.update(f for f, m in inst.reads.items() if m.any())
+            fam.update(f for f, m in inst.guard_reads.items() if m.any())
+            bad_shape = sorted(
+                f for masks in (inst.reads, inst.writes, inst.guard_reads)
+                for f, m in masks.items() if m.shape != shapes[f])
+            if bad_shape:
+                findings.append(Finding(
+                    PASS, ERROR, "footprint-shape-mismatch",
+                    field=inst.family, witness=inst.label,
+                    message=f"{inst.label}: footprint mask(s) for "
+                            f"{', '.join(bad_shape)} do not match the "
+                            "declared field shape — element-wise "
+                            "intersections downstream would be wrong"))
+            leaked = sorted(
+                f for f, m in inst.guard_reads.items()
+                if bool((m & ~inst.reads.get(f, np.zeros_like(m))).any()))
+            if leaked:
+                findings.append(Finding(
+                    PASS, ERROR, "guard-read-leak",
+                    field=inst.family, witness=inst.label,
+                    message=f"{inst.label}: guard reads element(s) of "
+                            f"{', '.join(leaked)} missing from the "
+                            "full read mask — the dependence matrix "
+                            "under-approximates (POR certificates "
+                            "would be unsound)"))
     n_state = len(lane_map.FIELDS)
     for name, closed, _params in traced_kernels(dims):
         syn = {lane_map.FIELDS[k]

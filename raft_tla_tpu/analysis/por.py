@@ -42,16 +42,21 @@ claim — the ``bounds.py`` contract):
   ``s·g = s`` then ``g`` would still be enabled at ``s·g``,
   contradicting the proof).
 
-On the base Raft alphabet this is an honest negative result: every
-instance fails C1 because ``Receive``'s reply-slot allocation scans the
-whole message bag (conservative whole-field ``msg``/server-field
-writes), making it statically dependent on every other family — the
-pass reports exactly which conditions block each family instead of
-claiming a reduction it cannot prove.  The machinery (certificates,
-packed device table, engine masking, coverage accounting) is exercised
-end-to-end by the oracle differentials in ``tests/test_por.py``; finer
-read/write granularity can flip families to certified without touching
-the engine.
+On the base Raft alphabet this is an honest negative result, and with
+the element-granular footprints it is now a PROVEN one: every instance
+fails C1, and the closure-refutation search (below) exhibits, for every
+non-vacuous instance, a concrete two-action non-commutation witness —
+executing the compiled kernels on type-correct probe states — so the
+block is inherent to the Raft alphabet (``Receive`` can address any
+server and its reply allocation scans the whole bag), not analyzer
+imprecision.  No footprint abstraction at any granularity can certify a
+singleton ample set here; the ``por-impossible`` findings carry the
+machine-checked witnesses, and the remaining ``blocked_by`` /
+``blocking_elements`` tables stay the exact worklist for model variants
+and simpler alphabets (ROADMAP item 4), where the same pass can
+certify.  The machinery (certificates, packed device table, engine
+masking, coverage accounting) is exercised end-to-end by the oracle
+differentials in ``tests/test_por.py`` via forged certifying tables.
 
 The emitted :class:`PorTable` is the device-consumable artifact: a
 per-instance ``ample_mask`` + ``priority`` order packed for the engines
@@ -67,7 +72,7 @@ from __future__ import annotations
 import dataclasses
 import hashlib
 import json
-from typing import Dict, FrozenSet, List, Optional, Tuple
+from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
@@ -77,7 +82,13 @@ from .interp import (IntervalDomain, TaintDomain, _ival, eval_jaxpr,
 from .report import ERROR, Finding, INFO, WARNING
 
 PASS = "por"
-TABLE_VERSION = 1
+#: v2: certificates are proved from ELEMENT-granular (slot/column)
+#: footprints and the payload records the granularity — v1 artifacts
+#: (field-granular proofs) are rejected at load and must be
+#: regenerated, so an engine can never apply a certificate proved under
+#: a coarser footprint encoding than the analyzer now emits.
+TABLE_VERSION = 2
+GRANULARITY = "element"
 
 #: C1/C2/C3 condition names, report order.
 CONDITIONS = ("nonempty", "closure", "visibility", "proviso")
@@ -106,21 +117,24 @@ def trace_predicate(kernel, dims):
     return jax.make_jaxpr(flat)(*in_avals)
 
 
-def predicate_read_sets(dims, predicates) -> Tuple[Dict[str, FrozenSet[str]],
+def predicate_read_sets(dims, predicates) -> Tuple[Dict[str, Dict],
                                                    List[str]]:
-    """``{name: fields the predicate may read}`` for ``[(name, kernel)]``,
-    via the taint domain (sound: a dropped dependency would be an interp
+    """``{name: {field: element mask}}`` for ``[(name, kernel)]``, via
+    the taint domain (sound: a dropped dependency would be an interp
     bug — the lint pass's read-set self-check guards the same property
-    on the action kernels).  Also returns the domain's imprecision
-    notes."""
+    on the action kernels).  Element-wise since the taint domain tracks
+    per-element masks; an invariant that reads only some lanes of a
+    field no longer blocks visibility for writes to the others.  Also
+    returns the domain's imprecision notes."""
     from .effects import _state_taints
+    from .interp import read_mask
     domain = TaintDomain()
     state = _state_taints(dims)
-    out: Dict[str, FrozenSet[str]] = {}
+    out: Dict[str, Dict] = {}
     for name, kernel in predicates:
         closed = trace_predicate(kernel, dims)
         res = eval_jaxpr(closed, list(state), domain)
-        out[name] = frozenset(res[0].deps)
+        out[name] = read_mask(res[0])
     return out, list(domain.notes)
 
 
@@ -208,6 +222,7 @@ class PorTable:
     priority: np.ndarray            # [G] int32
     predicates: Tuple[str, ...]
     version: int = TABLE_VERSION
+    granularity: str = GRANULARITY
 
     def __post_init__(self):
         self.ample_mask = np.asarray(self.ample_mask, bool)
@@ -222,6 +237,7 @@ class PorTable:
 
     def payload(self) -> dict:
         return {"version": self.version, "model": self.model,
+                "granularity": self.granularity,
                 "n_instances": self.n_instances,
                 "predicates": sorted(self.predicates),
                 "ample_mask": [int(b) for b in self.ample_mask],
@@ -240,10 +256,14 @@ class PorTable:
 
     @classmethod
     def from_json(cls, d: dict) -> "PorTable":
-        if d.get("version") != TABLE_VERSION:
+        if d.get("version") != TABLE_VERSION \
+                or d.get("granularity", GRANULARITY) != GRANULARITY:
             raise ValueError(
-                f"POR table version {d.get('version')!r} != supported "
-                f"{TABLE_VERSION}; regenerate with `analyze --passes por`")
+                f"POR table version {d.get('version')!r} "
+                f"(granularity {d.get('granularity')!r}) != supported "
+                f"{TABLE_VERSION}/{GRANULARITY!r} — certificates proved "
+                "under a coarser footprint encoding; regenerate with "
+                "`analyze --passes por`")
         table = cls(model=d["model"], n_instances=int(d["n_instances"]),
                     ample_mask=np.asarray(d["ample_mask"], bool),
                     priority=np.asarray(d["priority"], np.int32),
@@ -305,13 +325,191 @@ def check_table(table: PorTable, dims, invariant_names=None,
 # The pass
 
 
+# ---------------------------------------------------------------------------
+# Closure refutation: machine-checked impossibility witnesses
+#
+# A family blocked on C1 by the dependence matrix could in principle be
+# an analyzer artifact (over-approximate footprints) — the precision
+# worklist — or INHERENT: the actions genuinely do not commute, so no
+# sound footprint abstraction at any granularity can ever certify the
+# singleton.  The distinction is decided concretely: for each blocked
+# instance the pass searches a small pool of type-correct probe states
+# (``models.pystate.probe_states`` + the run's roots) for a two-action
+# non-commutation witness — a state where both actions are enabled and
+# either one disables the other or the diamond closes on different
+# states.  The check executes the COMPILED kernels (``build_expand``,
+# the exact programs the engine runs) on concrete states; a found
+# witness is therefore a semantic refutation of independence, not an
+# abstract-domain claim.  Instances whose guard is must-false on the
+# declared domain envelope (interval proof) are vacuous: they can never
+# execute, so no witness exists or is needed — a certificate for them
+# could never prune anything.
+#
+# Probe states need not be reachable: C1's independence requirement is
+# a property over the declared state domain (the same envelope every
+# other condition is proved against), so any type-correct witness
+# refutes it for every sound analyzer.
+
+
+@dataclasses.dataclass
+class ClosureRefutation:
+    """Per-instance outcome of the witness search."""
+
+    label: str
+    #: "witnessed" (concrete non-commutation found), "vacuous" (guard
+    #: must-false on the declared envelope), or "open" (no witness in
+    #: the probe pool — genuine precision worklist).
+    status: str
+    #: witnessed: the conflicting instance, the witness kind
+    #: ("disables", "disabled-by", "diamond") and the probe state index.
+    conflicts_with: Optional[str] = None
+    kind: Optional[str] = None
+    probe_state: Optional[int] = None
+
+    def to_json(self) -> dict:
+        out = {"label": self.label, "status": self.status}
+        if self.conflicts_with is not None:
+            out.update(conflicts_with=self.conflicts_with, kind=self.kind,
+                       probe_state=self.probe_state)
+        return out
+
+
+def _canonical_state(tree, idx) -> tuple:
+    """Hashable canonical view of one successor state slice: plain
+    fields verbatim, the message bag as a sorted multiset of occupied
+    (row, count) pairs — slot-permutation invariant, so two orders of a
+    commuting pair that allocate reply slots differently still compare
+    equal."""
+    fields = {f: np.asarray(getattr(tree, f))[idx] for f in tree._fields}
+    msg, cnt = fields.pop("msg"), fields.pop("msg_cnt")
+    occ = cnt > 0
+    bag = sorted((tuple(int(x) for x in row), int(c))
+                 for row, c in zip(msg[occ], cnt[occ]))
+    plain = tuple((f, tuple(np.asarray(v).reshape(-1).tolist()))
+                  for f, v in sorted(fields.items()))
+    return plain, tuple(bag)
+
+
+def _vacuous_instances(dims, env) -> Dict[int, bool]:
+    """{grid index: guard is must-false on the declared envelope} — the
+    interval-domain proof that an instance can never execute (e.g. the
+    ``AppendEntries(i, i)`` grid corners, whose guard is
+    parameter-concrete False)."""
+    out: Dict[int, bool] = {}
+    kernels = {name: (closed, params)
+               for name, closed, params in traced_kernels(dims)}
+    for g in range(dims.n_instances):
+        fam_code, params = dims.instance_info(g)
+        closed, _arrays = kernels[dims.family_names[fam_code]]
+        domain = IntervalDomain()
+        pvals = [np.int32(v) for v in params.values()]
+        outs = eval_jaxpr(closed, list(env) + pvals, domain)
+        out[g] = bool(np.all(np.asarray(outs[0].hi) == 0))
+    return out
+
+
+def closure_refutations(dims, probe_pool, env) -> List[ClosureRefutation]:
+    """Run the witness search over ``probe_pool`` (PyStates).  Returns
+    one :class:`ClosureRefutation` per action instance."""
+    import jax
+
+    from ..models.actions import build_expand
+    from ..models.schema import encode_state
+
+    G = dims.n_instances
+    labels = [dims.describe_instance(g) for g in range(G)]
+    vac = _vacuous_instances(dims, env)
+    out: Dict[int, ClosureRefutation] = {
+        g: ClosureRefutation(labels[g], "vacuous")
+        for g in range(G) if vac[g]}
+
+    expand = jax.jit(build_expand(dims))
+    expand_v = jax.jit(jax.vmap(build_expand(dims)))
+    for si, ps in enumerate(probe_pool):
+        if len(out) == G:
+            break
+        enc = encode_state(ps, dims)
+        cands, en, ovf = expand(enc)
+        en = np.asarray(en) & ~np.asarray(ovf)
+        if not en.any():
+            continue
+        c2, en2, ovf2 = expand_v(cands)
+        en2, ovf2 = np.asarray(en2), np.asarray(ovf2)
+        canon: Dict[Tuple[int, int], tuple] = {}
+
+        def second(g, h):
+            if (g, h) not in canon:
+                canon[(g, h)] = _canonical_state(c2, (g, h))
+            return canon[(g, h)]
+
+        for g in range(G):
+            if g in out or not en[g]:
+                continue
+            for h in range(G):
+                if h == g or not en[h]:
+                    continue
+                # Disabling counts only when the second step is cleanly
+                # disabled, not when its ENCODING overflowed (an
+                # overflow lane reports enabled=False with the overflow
+                # flag set — that is a capacity artifact, not semantics).
+                if not en2[g, h] and not ovf2[g, h]:
+                    out[g] = ClosureRefutation(
+                        labels[g], "witnessed", labels[h], "disables", si)
+                    break
+                if not en2[h, g] and not ovf2[h, g]:
+                    out[g] = ClosureRefutation(
+                        labels[g], "witnessed", labels[h], "disabled-by",
+                        si)
+                    break
+                if not (en2[g, h] and en2[h, g]) \
+                        or ovf2[g, h] or ovf2[h, g]:
+                    continue
+                if second(g, h) != second(h, g):
+                    out[g] = ClosureRefutation(
+                        labels[g], "witnessed", labels[h], "diamond", si)
+                    break
+    for g in range(G):
+        if g not in out:
+            out[g] = ClosureRefutation(labels[g], "open")
+    return [out[g] for g in range(G)]
+
+
+# ---------------------------------------------------------------------------
+# The pass
+
+
+def _mask_overlap(writes: Dict[str, np.ndarray],
+                  reads: Dict[str, np.ndarray]) -> List[Tuple[str,
+                                                              np.ndarray]]:
+    """Element-wise intersection of a write and a read footprint:
+    ``[(field, overlap mask), ...]`` for the fields that clash."""
+    out = []
+    for f, m in writes.items():
+        r = reads.get(f)
+        if r is not None and bool((m & r).any()):
+            out.append((f, m & r))
+    return out
+
+
+def element_label(field: str, mask: np.ndarray) -> str:
+    """Human-readable label of the first blocking element of a mask —
+    the ``(family, field, slot)`` triple rendering the worklist uses.
+    A fully-set mask reads as the whole field."""
+    if mask.all():
+        return f"{field}[*]"
+    idx = np.unravel_index(int(np.flatnonzero(mask.reshape(-1))[0]),
+                           mask.shape)
+    if mask.ndim == 2 and mask[idx[0]].all():
+        return f"{field}[{idx[0]},*]"
+    return f"{field}[{','.join(str(int(k)) for k in idx)}]"
+
+
 def _build_certificates(dims, summary, read_sets, bounds):
     """One :class:`Certificate` per action instance."""
+    from .effects import conflict_elements
     instances = summary.instances
     G = len(instances)
     indep = summary.independent
-    pred_reads: FrozenSet[str] = frozenset().union(*read_sets.values()) \
-        if read_sets else frozenset()
     env = _envelope_intervals(dims, bounds)
     kernels = {name: (closed, params)
                for name, closed, params in traced_kernels(dims)}
@@ -326,24 +524,34 @@ def _build_certificates(dims, summary, read_sets, bounds):
         # enabled, so the chosen ample set is non-empty by construction.
         conds["nonempty"] = (True, "ample applied only where enabled")
 
-        dep_fams = sorted({instances[h].family for h in range(G)
-                           if h != g and not indep[g, h]})
-        if dep_fams:
+        dep = [h for h in range(G) if h != g and not indep[g, h]]
+        if dep:
+            dep_fams = sorted({instances[h].family for h in dep})
+            # Name the first blocking element — the precision worklist's
+            # exact next step for this instance.
+            kind, fld, mask = conflict_elements(inst, instances[dep[0]])[0]
             conds["closure"] = (
                 False, "statically dependent on instance(s) of "
                        f"{', '.join(dep_fams)} — a deferred dependent "
-                       "action could observe this instance's writes")
+                       "action could observe this instance's writes; "
+                       f"first blocking element: {kind} on "
+                       f"{element_label(fld, mask)} vs "
+                       f"{instances[dep[0]].label}")
         else:
             conds["closure"] = (True, "independent of every other "
                                       "instance (persistent singleton)")
 
-        vis = sorted(set(inst.writes) & pred_reads)
+        vis = []
+        blockers = set()
+        for name, reads in read_sets.items():
+            clash = _mask_overlap(inst.writes, reads)
+            if clash:
+                blockers.add(name)
+                vis.extend(element_label(f, m) for f, m in clash)
         if vis:
-            blockers = sorted(name for name, reads in read_sets.items()
-                              if set(inst.writes) & reads)
             conds["visibility"] = (
-                False, f"writes {', '.join(vis)} read by checked "
-                       f"predicate(s) {', '.join(blockers)}")
+                False, f"writes {', '.join(sorted(set(vis)))} read by "
+                       f"checked predicate(s) {', '.join(sorted(blockers))}")
         else:
             conds["visibility"] = (True, "writes invisible to every "
                                          "checked predicate")
@@ -377,8 +585,6 @@ def _verify_certified(certs, summary, read_sets, dims,
     Any failure is an ERROR — the pass then exits nonzero rather than
     emitting a table whose side conditions do not hold."""
     findings = []
-    pred_reads = frozenset().union(*read_sets.values()) if read_sets \
-        else frozenset()
     G = len(summary.instances)
     if any(c.ample for c in certs):
         env = _envelope_intervals(dims, bounds)
@@ -392,8 +598,10 @@ def _verify_certified(certs, summary, read_sets, dims,
         row = tuple(params.values())
         proviso_ok, _n = self_disabling(
             kernels[dims.family_names[fam_code]], row, env)
+        visible = any(_mask_overlap(summary.instances[g].writes, reads)
+                      for reads in read_sets.values())
         ok = int(summary.independent[g].sum()) == G - 1 \
-            and not (set(summary.instances[g].writes) & pred_reads) \
+            and not visible \
             and proviso_ok
         if not ok:
             findings.append(Finding(
@@ -407,8 +615,8 @@ def _verify_certified(certs, summary, read_sets, dims,
 
 
 def analyze(dims, bounds=None, invariant_names=None, invariants=None,
-            constraint=None, effect_summary=None
-            ) -> Tuple[dict, List[Finding]]:
+            constraint=None, effect_summary=None, init_states=None,
+            refute=True) -> Tuple[dict, List[Finding]]:
     """Run the POR pass.  Returns ``(summary_json, findings)``; the
     packed table rides in ``summary_json["table"]``.
 
@@ -416,7 +624,11 @@ def analyze(dims, bounds=None, invariant_names=None, invariants=None,
     ``invariant_names`` (registry lookup; None = the conservative full
     suite); ``constraint`` is the evaluated CONSTRAINT kernel (falls
     back to one built from ``bounds``).  ``effect_summary`` reuses the
-    effects pass's live result when both passes run in one invocation."""
+    effects pass's live result when both passes run in one invocation.
+    ``init_states`` (PyStates) extend the probe pool of the closure
+    refutation search; ``refute=False`` skips that search (pure
+    trace-time analysis, e.g. for variant models without probe
+    states)."""
     from ..models.invariants import CONSTRAINT_PREDICATE, \
         checkable_predicates
     from . import effects
@@ -445,12 +657,28 @@ def analyze(dims, bounds=None, invariant_names=None, invariants=None,
     findings.extend(_verify_certified(certs, effect_summary, read_sets,
                                       dims, bounds))
 
+    # Closure refutation (machine-checked impossibility) for instances
+    # the dependence matrix blocks on C1: concrete non-commutation
+    # witnesses split "blocked by analyzer imprecision" (worklist) from
+    # "blocked inherently" (no footprint precision can ever certify).
+    refutations: Dict[str, ClosureRefutation] = {}
+    blocked_closure = [c for c in certs if "closure" in c.blocking()]
+    if refute and blocked_closure:
+        from ..models.pystate import probe_states
+        pool = list(init_states or []) + probe_states(dims)
+        env = _envelope_intervals(dims, bounds)
+        refutations = {r.label: r
+                       for r in closure_refutations(dims, pool, env)}
+
     # Aggregate per family: one WARNING per widened family (conservative
-    # toward full expansion), one INFO per certified family.
+    # toward full expansion), one INFO per certified family, one INFO
+    # per family whose closure block is fully witnessed (impossible).
     by_family: Dict[str, List[Certificate]] = {}
     for c in certs:
         by_family.setdefault(c.family, []).append(c)
     fam_json = {}
+    instances = effect_summary.instances
+    by_label = {i.label: k for k, i in enumerate(instances)}
     for fam, group in by_family.items():
         n_cert = sum(c.ample for c in group)
         blocked: Dict[str, int] = {}
@@ -459,6 +687,63 @@ def analyze(dims, bounds=None, invariant_names=None, invariants=None,
                 blocked[cond] = blocked.get(cond, 0) + 1
         fam_json[fam] = {"instances": len(group), "certified": n_cert,
                          "blocked_by": blocked}
+        # Top blocking elements for the worklist rendering: count, per
+        # (other family, element) pair, how many of this family's
+        # dependence conflicts anchor there.
+        triples: Dict[Tuple[str, str, str], int] = {}
+        for c in group:
+            g = by_label[c.label]
+            ia = instances[g]
+            for h in np.flatnonzero(~effect_summary.independent[g]):
+                if h == g:
+                    continue
+                ib = instances[int(h)]
+                for kind, fld, m in effects.conflict_elements(ia, ib):
+                    key = (ib.family, element_label(fld, m), kind)
+                    triples[key] = triples.get(key, 0) + 1
+        fam_json[fam]["blocking_elements"] = [
+            {"family": f, "element": e, "kind": k, "pairs": n}
+            for (f, e, k), n in sorted(triples.items(),
+                                       key=lambda kv: -kv[1])[:5]]
+        if fam == "Receive" and refute and blocked.get("closure"):
+            # The mtype/(i, j) case-split (the taint twin of bounds.py's
+            # Receive split): every case's server-field writes are
+            # row-local to that case's dest — machine-readable evidence
+            # that the whole-field union is forced by reachable message
+            # headers, not by analyzer widening.
+            cases = effects.receive_case_effects(dims)
+            server_rows = {f: s for f, s in
+                           lane_map.field_shapes(dims).items()
+                           if f not in ("msg", "msg_cnt")}
+            row_local = 0
+            for (_t, i, _j), fp in cases.items():
+                rows = {int(r) for f, m in fp["writes"].items()
+                        if f in server_rows for r in np.nonzero(m)[0]}
+                row_local += rows <= {i}
+            fam_json[fam]["case_split"] = {
+                "slot": 0, "cases": len(cases),
+                "server_writes_row_local": row_local,
+                "example": {
+                    f"mtype={t},i={i},j={j}":
+                        sorted(element_label(f, m)
+                               for f, m in fp["writes"].items())
+                    for (t, i, j), fp in list(cases.items())[:1]},
+            }
+        if refutations:
+            # Only closure-BLOCKED instances need (or can have) a
+            # witness: a certified instance is independent of
+            # everything, so no non-commutation witness exists and
+            # counting it as "open" would mislabel a partially
+            # certified family as precision worklist.
+            rs = [refutations[c.label] for c in group
+                  if "closure" in c.blocking()]
+            fam_json[fam]["closure_refutation"] = {
+                "witnessed": sum(r.status == "witnessed" for r in rs),
+                "vacuous": sum(r.status == "vacuous" for r in rs),
+                "open": [r.label for r in rs if r.status == "open"],
+                "witnesses": [r.to_json() for r in rs
+                              if r.status == "witnessed"][:3],
+            }
         if n_cert == len(group):
             findings.append(Finding(
                 PASS, INFO, "por-certified", field=fam,
@@ -476,6 +761,21 @@ def analyze(dims, bounds=None, invariant_names=None, invariants=None,
                         f"{cond} unproved: "
                         f"{first.conditions[cond][1]}",
                 details={"blocked_by": blocked}))
+        if refutations and blocked.get("closure"):
+            ref = fam_json[fam]["closure_refutation"]
+            if not ref["open"]:
+                wit = ref["witnesses"][0] if ref["witnesses"] else None
+                findings.append(Finding(
+                    PASS, INFO, "por-impossible", field=fam,
+                    witness=wit["label"] if wit else None,
+                    message=f"{fam}: the closure block is INHERENT, not "
+                            "analyzer imprecision — every instance has "
+                            "a concrete two-action non-commutation "
+                            "witness (or a proof it can never execute)"
+                            + (f"; e.g. {wit['label']} vs "
+                               f"{wit['conflicts_with']} "
+                               f"({wit['kind']})" if wit else ""),
+                    details=ref))
 
     mask = np.array([c.ample for c in certs], bool)
     priority = np.arange(len(certs), dtype=np.int32)
@@ -487,10 +787,27 @@ def analyze(dims, bounds=None, invariant_names=None, invariants=None,
         "certified": table.certified,
         "predicates": {name: sorted(fields)
                        for name, fields in read_sets.items()},
+        "predicate_elements": {
+            name: {f: int(m.sum()) for f, m in fields.items()}
+            for name, fields in read_sets.items()},
         "families": fam_json,
+        "closure_refutation": _refutation_totals(certs, refutations),
         "table": table.to_json(),
     }
     return summary, findings
+
+
+def _refutation_totals(certs, refutations) -> dict:
+    """Top-level witness-search tally over the closure-BLOCKED
+    instances only (certified instances have no witness to find)."""
+    rs = [refutations[c.label] for c in certs
+          if refutations and "closure" in c.blocking()]
+    return {
+        "ran": bool(refutations),
+        "witnessed": sum(r.status == "witnessed" for r in rs),
+        "vacuous": sum(r.status == "vacuous" for r in rs),
+        "open": sorted(r.label for r in rs if r.status == "open"),
+    }
 
 
 def build_table(dims, bounds=None, invariant_names=None, invariants=None,
@@ -501,7 +818,10 @@ def build_table(dims, bounds=None, invariant_names=None, invariants=None,
     summary, findings = analyze(
         dims, bounds=bounds, invariant_names=invariant_names,
         invariants=invariants, constraint=constraint,
-        effect_summary=effect_summary)
+        effect_summary=effect_summary,
+        # The witness search classifies blocked instances but never
+        # changes the mask — skip it on the engine-construction path.
+        refute=False)
     errors = [f for f in findings if f.severity == ERROR]
     if errors:
         raise ValueError(f"POR certification failed: {errors[0].message}")

@@ -149,6 +149,8 @@ class Report:
             lines.append(
                 f"{f.severity:7s} {f.pass_name}/{f.code}{loc}: "
                 f"{f.message}{wit}{mark}")
+        if "por" in self.pass_summaries:
+            lines.append(render_por_table(self.pass_summaries["por"]))
         c = self.severity_counts()
         lines.append(f"analysis: {c[ERROR]} error(s), {c[WARNING]} "
                      f"warning(s), {c[INFO]} info note(s) — "
@@ -159,3 +161,42 @@ class Report:
         with open(path, "w") as f:
             json.dump(self.to_json(), f, indent=2, sort_keys=True)
             f.write("\n")
+
+
+def render_por_table(summary: dict) -> str:
+    """Text rendering of the POR pass summary: per-family certified /
+    blocked counts, the closure-refutation verdict, and the top
+    blocking ``(family, field, slot)`` triples — the precision worklist
+    readable straight off ``analyze`` output, no JSON spelunking."""
+    fams = summary.get("families", {})
+    lines = [f"por: {summary.get('certified', 0)}/"
+             f"{summary.get('n_instances', 0)} instance(s) certified"]
+    if not fams:
+        return "\n".join(lines)
+    name_w = max(len(n) for n in fams) + 2
+    header = (f"  {'family':<{name_w}}{'inst':>5} {'cert':>5} "
+              f"{'closure':>8}  top blocking element")
+    lines.append(header)
+    for fam, d in fams.items():
+        ref = d.get("closure_refutation")
+        if d.get("certified") == d.get("instances"):
+            closure = "proved"
+        elif ref is None:
+            closure = "blocked"
+        elif ref.get("open"):
+            closure = "open"          # precision worklist
+        else:
+            closure = "inherent"      # machine-checked impossibility
+        top = d.get("blocking_elements") or []
+        top_s = (f"{top[0]['family']} {top[0]['kind']} "
+                 f"{top[0]['element']} ({top[0]['pairs']} pairs)") \
+            if top else "-"
+        lines.append(f"  {fam:<{name_w}}{d.get('instances', 0):>5} "
+                     f"{d.get('certified', 0):>5} {closure:>8}  {top_s}")
+    ref = summary.get("closure_refutation", {})
+    if ref.get("ran"):
+        lines.append(
+            f"  closure refutation: {ref.get('witnessed', 0)} instance(s) "
+            f"witnessed non-commuting, {ref.get('vacuous', 0)} provably "
+            f"never enabled, {len(ref.get('open', []))} open")
+    return "\n".join(lines)

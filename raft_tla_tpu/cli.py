@@ -531,8 +531,10 @@ def main(argv=None):
                         "'Static analysis')")
     a.add_argument("--passes", default=None,
                    help="comma-separated subset of effects,bounds,lint,"
-                        "por (default: all); an unknown pass name exits "
-                        "2 with the valid list")
+                        "por (default: all); prerequisite passes are "
+                        "added automatically (por/lint pull in "
+                        "effects); an unknown pass name exits 2 with "
+                        "the valid list")
     a.add_argument("--por-artifact", default=None, metavar="FILE",
                    help="write the POR reduction table (versioned, "
                         "fingerprinted ample_mask + priority) here — "

@@ -89,6 +89,25 @@ def build_chunk_body(*, dims, expand, fingerprint, pack_ok, inv_fns,
         raise ValueError(f"unknown enqueue method {enqueue_method!r}")
     if (por_mask is None) != (por_priority is None):
         raise ValueError("por_mask and por_priority must be given together")
+    if por_mask is not None:
+        # Last-line admission re-check at the compilation boundary: a
+        # reduction mask that does not cover the instance grid exactly
+        # (or a non-bool mask, which jnp.where would happily treat as
+        # weights) must fail HERE, not silently mis-mask lanes.  The
+        # table-level checks (fingerprint, model signature, predicate
+        # coverage, encoding version) live in analysis/por.check_table;
+        # this guards the raw arrays actually baked into the program.
+        if tuple(por_mask.shape) != (G,) \
+                or tuple(por_priority.shape) != (G,):
+            raise ValueError(
+                f"POR mask/priority must be [{G}] (the action-instance "
+                f"grid), got {tuple(por_mask.shape)} / "
+                f"{tuple(por_priority.shape)}")
+        if por_mask.dtype != jnp.bool_ \
+                or por_priority.dtype != jnp.int32:
+            raise ValueError(
+                f"POR mask/priority must be bool/int32, got "
+                f"{por_mask.dtype} / {por_priority.dtype}")
     if fused_tail is not None and v2 is None:
         raise ValueError("fused_tail (v3) requires the v2 delta pipeline")
     BG = B * G

@@ -194,3 +194,41 @@ def format_state(s: PyState, dims: RaftDims) -> str:
     for m in msgs:
         lines.append(f"    {m['count']}x {m['msg']}")
     return "\n".join(lines)
+
+
+def probe_states(dims: RaftDims):
+    """Type-correct probe states for the POR pass's concrete
+    closure-refutation search (analysis/por.py): a handful of states
+    that together enable every base action instance, so the pass can
+    exhibit a CONCRETE two-action non-commutation witness per instance.
+    The states need not be reachable — action independence (and hence
+    the C1 closure condition) is a property over the declared state
+    domain, so any type-correct witness refutes it for every sound
+    footprint abstraction.  All values stay inside
+    ``analysis.lane_map.field_domains``."""
+    from .dims import CANDIDATE, LEADER
+    n = dims.n_servers
+    base = init_state(dims)
+    full = (1 << n) - 1
+    out = [base]
+    # Every server a candidate holding a quorum of granted votes (and no
+    # recorded responses, so RequestVote(i, j) stays enabled for all j):
+    # enables BecomeLeader/RequestVote/Timeout everywhere.
+    out.append(base.replace(role=(CANDIDATE,) * n,
+                            current_term=(2,) * n,
+                            votes_granted=(full,) * n))
+    # Every server a leader with log headroom: enables ClientRequest,
+    # AdvanceCommitIndex and AppendEntries(i != j) everywhere.
+    out.append(base.replace(role=(LEADER,) * n, current_term=(2,) * n))
+    # Every message slot occupied by a distinct single-copy message with
+    # mterm above every server term (the UpdateTerm case of Receive is
+    # enabled regardless of roles): enables Receive / DuplicateMessage /
+    # DropMessage on every slot.
+    msgs = []
+    for s in range(dims.n_msg_slots):
+        src = s % n
+        dst = (s // n) % n
+        last_idx = s // (n * n)
+        msgs.append(((RVQ, src, dst, 2, 0, last_idx), 1))
+    out.append(base.replace(messages=frozenset(msgs)))
+    return out

@@ -36,6 +36,11 @@ BENCH_r01–r05 files predate chunk_stages/coverage and still diff):
   fingerprint, tail (dedup_insert+enqueue | insert_enqueue), total —
   with a note, instead of silently comparing an empty intersection
   (or refusing the diff).
+- POR pruned fraction (``pruned / (pruned + generated)`` from the
+  coverage object): compared whenever either side pruned anything; a
+  candidate whose fraction falls more than ``--pruned-drift`` points
+  below the baseline regresses — a certified reduction collapsing back
+  to full expansion must fail loudly.
 - coverage mix: per-action share of total generated; an action whose
   share moves more than ``--coverage-drift`` (absolute percentage
   points) is flagged.  This is a semantics drift detector, not a perf
@@ -243,6 +248,47 @@ def diff_stages(old: dict, new: dict, d: Diff, max_regress: float):
                       f"{nc * 1e3:.2f} ms/batch")
 
 
+def pruned_fraction(cov: dict):
+    """(pruned count, pruned share of attempted expansions in %) from a
+    coverage object — the POR reduction's first-class metric."""
+    pr = sum(v.get("pruned", 0) for v in cov.values())
+    gen = sum(v.get("generated", 0) for v in cov.values())
+    total = pr + gen
+    return pr, (pr / total * 100.0) if total else 0.0
+
+
+def diff_pruned(old: dict, new: dict, d: Diff, drift_pts: float):
+    """POR reduced-vs-full accounting as a first-class compared metric:
+    the pruned FRACTION (pruned / (pruned + generated) expansions).  A
+    candidate whose fraction falls more than ``--pruned-drift``
+    percentage points below the baseline regresses — a certified
+    reduction that silently collapsed back to full expansion must fail
+    the gate, not hide inside an unchanged headline.  Gains are noted
+    (the distinct/s gates stay the arbiter of whether pruning pays)."""
+    ocov = old.get("coverage") or {}
+    ncov = new.get("coverage") or {}
+    op, of = pruned_fraction(ocov)
+    np_, nf = pruned_fraction(ncov)
+    if not op and not np_:
+        return
+    if not ocov or not ncov:
+        # Legacy bench without a coverage object on one side: the
+        # fraction cannot be compared, but a pruning run diffed against
+        # (or serving as) a legacy baseline still reports the number.
+        side = "baseline" if not ocov else "candidate"
+        d.note(f"POR pruned expansions: {op:,} ({of:.2f}%) -> "
+               f"{np_:,} ({nf:.2f}%) — {side} has no coverage object, "
+               "fraction not gated")
+        return
+    d.note(f"POR pruned expansions: {op:,} ({of:.2f}%) -> "
+           f"{np_:,} ({nf:.2f}%)")
+    if of - nf > drift_pts:
+        d.regress(f"POR pruned fraction fell {of - nf:.2f} pts "
+                  f"({of:.2f}% -> {nf:.2f}%, > {drift_pts:g} pts "
+                  "allowed) — the reduction collapsed toward full "
+                  "expansion")
+
+
 def diff_coverage(old: dict, new: dict, d: Diff, drift_pts: float):
     # generated_by_action predates the coverage object and carries the
     # same generated series — accept either so old BENCH files diff.
@@ -254,15 +300,6 @@ def diff_coverage(old: dict, new: dict, d: Diff, drift_pts: float):
           else new.get("generated_by_action") or {})
     if not og or not ng:
         return
-    # POR reduced-vs-full accounting: report the generated-state
-    # reduction whenever either side's coverage carries pruned lanes
-    # (the distinct/s regression gates above stay the arbiter — a
-    # reduction that does not pay off in rate still fails there).
-    op = sum(v.get("pruned", 0) for v in ocov.values()) if ocov else 0
-    np_ = sum(v.get("pruned", 0) for v in ncov.values()) if ncov else 0
-    if op or np_:
-        d.note(f"POR pruned expansions: {op:,} -> {np_:,} "
-               f"(generated {sum(og.values()):,} -> {sum(ng.values()):,})")
     ot, nt = sum(og.values()), sum(ng.values())
     if not ot or not nt:
         return
@@ -346,6 +383,12 @@ def main(argv=None) -> int:
                    help="allowed absolute drift (percentage points) in "
                         "any action's share of generated states "
                         "(default 5.0)")
+    p.add_argument("--pruned-drift", type=float, default=1.0,
+                   help="allowed drop (percentage points) in the POR "
+                        "pruned fraction (pruned/(pruned+generated)) "
+                        "vs the baseline — a collapsed reduction fails "
+                        "(default 1.0; only checked when either side "
+                        "pruned anything)")
     args = p.parse_args(argv)
 
     try:
@@ -378,6 +421,7 @@ def main(argv=None) -> int:
     diff_headline(old, new, d, args.max_regress)
     diff_phases(old, new, d, args.phase_max_regress, args.phase_floor)
     diff_stages(old, new, d, args.stage_max_regress)
+    diff_pruned(old, new, d, args.pruned_drift)
     diff_coverage(old, new, d, args.coverage_drift)
     return d.render()
 

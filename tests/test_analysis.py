@@ -404,3 +404,229 @@ def test_check_packable_names_lane_and_writers():
     with pytest.raises(ValueError,
                        match=r"slot 1 column 4.*mlastLogTerm.*RequestVote"):
         check_packable(st._replace(msg=msg), DIMS)
+
+
+# ---------------------------------------------------------------------------
+# element-wise taint (the slot/column-granular footprints POR consumes)
+
+
+def _field_taints(shapes):
+    """State-input taints for a toy 'model' of named fields."""
+    from raft_tla_tpu.analysis.interp import _taint
+    out = []
+    for f, shp in shapes:
+        out.append(_taint({}, {f: np.ones(shp, bool)}, f,
+                          np.zeros(shp, bool), np.zeros(shp, bool),
+                          np.zeros(shp, np.int64), np.int32))
+    return out
+
+
+def test_interp_gather_known_index_is_element_precise():
+    """arr[i] with a parameter-concrete index reads exactly element i;
+    a state-dependent index widens to the whole axis — per element,
+    with the index's own reads joined in."""
+    import jax
+    import jax.numpy as jnp
+    from raft_tla_tpu.analysis.interp import TaintDomain, eval_jaxpr, \
+        read_mask
+
+    closed = jax.make_jaxpr(lambda a, i: a[i])(
+        jnp.zeros(5, jnp.int32), jnp.int32(0))
+    (arr,) = _field_taints([("X", (5,))])
+    dom = TaintDomain()
+    out = eval_jaxpr(closed, [arr, np.int32(3)], dom)[0]
+    assert read_mask(out)["X"].tolist() == [0, 0, 0, 1, 0]
+
+    # Two-level indexing: known row, state-dependent column -> the row.
+    closed2 = jax.make_jaxpr(lambda a, ln, i: a[i, jnp.clip(ln[i], 0, 3)])(
+        jnp.zeros((3, 4), jnp.int32), jnp.zeros(3, jnp.int32),
+        jnp.int32(0))
+    a2, ln2 = _field_taints([("A", (3, 4)), ("L", (3,))])
+    out2 = eval_jaxpr(closed2, [a2, ln2, np.int32(1)], TaintDomain())[0]
+    rm = read_mask(out2)
+    assert rm["A"][1].all() and not rm["A"][0].any() and not rm["A"][2].any()
+    assert rm["L"].tolist() == [0, 1, 0]
+
+    # State-dependent index over the first axis: whole field.
+    idx_dep = eval_jaxpr(closed, [arr, eval_jaxpr(
+        closed, [arr, np.int32(0)], dom)[0]], dom)[0]
+    assert read_mask(idx_dep)["X"].all()
+
+
+def test_interp_select_point_update_masks():
+    """where(arange == i, v, field): write diff confined to row i, and
+    the positional read restriction keeps the read at row i too."""
+    import jax
+    import jax.numpy as jnp
+    from raft_tla_tpu.analysis.interp import TaintDomain, eval_jaxpr
+    from raft_tla_tpu.analysis.effects import _write_reads
+
+    def set1(a, i):
+        return jnp.where(jnp.arange(5) == i, a + 1, a)
+
+    closed = jax.make_jaxpr(set1)(jnp.zeros(5, jnp.int32), jnp.int32(0))
+    (arr,) = _field_taints([("X", (5,))])
+    out = eval_jaxpr(closed, [arr, np.int32(2)], TaintDomain())[0]
+    assert out.origin == "X"
+    assert out.diff.tolist() == [0, 0, 1, 0, 0]
+    reads = _write_reads(out, out.diff)
+    assert reads["X"].tolist() == [0, 0, 1, 0, 0]
+
+
+def test_interp_dynamic_update_slice_and_scatter_masks():
+    """Known-position writes stay positionally confined (diff covers
+    exactly the window); an unknown position widens diff to the whole
+    array but keeps the operand's positional reads."""
+    import jax
+    import jax.numpy as jnp
+    from raft_tla_tpu.analysis.interp import TaintDomain, eval_jaxpr
+
+    def dus(a, v, k):
+        return jax.lax.dynamic_update_slice(a, v[None], (k,))
+
+    closed = jax.make_jaxpr(dus)(jnp.zeros(6, jnp.int32),
+                                 jnp.int32(0), jnp.int32(0))
+    (arr,) = _field_taints([("X", (6,))])
+    opaque_v = eval_jaxpr(
+        jax.make_jaxpr(lambda a: a.sum())(jnp.zeros(6, jnp.int32)),
+        [arr], TaintDomain())[0]
+    out = eval_jaxpr(closed, [arr, opaque_v, np.int32(4)],
+                     TaintDomain())[0]
+    assert out.origin == "X" and out.diff.tolist() == [0, 0, 0, 0, 1, 0]
+
+    out_unk = eval_jaxpr(closed, [arr, opaque_v, opaque_v],
+                         TaintDomain())[0]
+    assert out_unk.origin == "X" and out_unk.diff.all()
+
+    def at_set(a, k, v):
+        return a.at[k].set(v)
+
+    closed2 = jax.make_jaxpr(at_set)(jnp.zeros(6, jnp.int32),
+                                     jnp.int32(0), jnp.int32(0))
+    out2 = eval_jaxpr(closed2, [arr, np.int32(1), opaque_v],
+                      TaintDomain())[0]
+    assert out2.origin == "X" and out2.diff.tolist() == [0, 1, 0, 0, 0, 0]
+
+
+def test_interp_planted_whole_field_widen_still_caught():
+    """An unhandled primitive must still widen to the whole footprint
+    AND surface an imprecision note — conservatism is load-bearing."""
+    import jax
+    import jax.numpy as jnp
+    from raft_tla_tpu.analysis.interp import TaintDomain, eval_jaxpr, \
+        read_mask
+
+    closed = jax.make_jaxpr(lambda a: jnp.sort(a)[0])(
+        jnp.zeros(5, jnp.int32))
+    (arr,) = _field_taints([("X", (5,))])
+    dom = TaintDomain()
+    out = eval_jaxpr(closed, [arr], dom)[0]
+    assert read_mask(out)["X"].all()
+    assert any("sort" in n for n in dom.notes)
+
+
+def test_elementwise_instance_footprints(effect_summary):
+    """The headline precision wins: point actions read/write exactly
+    their own rows/slots (the unlock ROADMAP item 2 named)."""
+    summary, _ = effect_summary
+    by_label = {i.label: i for i in summary.instances}
+    t1 = by_label["Timeout(i=1)"]
+    for f, m in t1.reads.items():
+        assert m.sum() == 1 and m[1], (f, m)
+    dup = by_label["DuplicateMessage(slot=2)"]
+    assert {f: m.tolist() for f, m in dup.reads.items()} \
+        == {"msg_cnt": [0, 0, 1, 0]}
+    assert {f: m.tolist() for f, m in dup.writes.items()} \
+        == {"msg_cnt": [0, 0, 1, 0]}
+    # Receive's footprint stays whole-field — genuinely data-dependent.
+    rcv = by_label["Receive(slot=0)"]
+    assert rcv.reads["commit"].all() and rcv.writes["role"].all()
+
+
+def test_elementwise_matrix_refines_field_granularity(effect_summary):
+    """Pairs a field-granular analysis must call dependent commute at
+    element granularity: same-family point actions on different servers
+    and cross-family actions on disjoint rows."""
+    summary, _ = effect_summary
+    idx = {i.label: k for k, i in enumerate(summary.instances)}
+    ind = summary.independent
+    assert ind[idx["Timeout(i=0)"], idx["Timeout(i=1)"]]
+    assert ind[idx["Restart(i=0)"], idx["AdvanceCommitIndex(i=1)"]]
+    assert ind[idx["ClientRequest(i=0, v=1)"], idx["Timeout(i=2)"]]
+    assert ind[idx["DuplicateMessage(slot=0)"],
+               idx["DuplicateMessage(slot=3)"]]
+    # ... while real element overlaps stay dependent.
+    assert not ind[idx["Timeout(i=0)"], idx["Restart(i=0)"]]
+    assert not ind[idx["Timeout(i=0)"], idx["Receive(slot=0)"]]
+
+
+def test_footprints_serialized_versioned_roundtrip(effect_summary):
+    """The versioned slot-level encoding: masks survive the hex
+    round-trip exactly, and both decoders reject a version mismatch
+    instead of misreading slot masks."""
+    from raft_tla_tpu.analysis import effects
+    summary, _ = effect_summary
+    sj = effects.summary_json(summary)
+    assert sj["footprints_version"] == effects.FOOTPRINTS_VERSION
+    json.dumps(sj)
+    fps = effects.footprints_from_json(sj)
+    assert len(fps) == len(summary.instances)
+    for fp, inst in zip(fps, summary.instances):
+        for kind, masks in (("reads", inst.reads),
+                            ("writes", inst.writes),
+                            ("guard_reads", inst.guard_reads)):
+            assert set(fp[kind]) == set(masks)
+            for f, m in masks.items():
+                assert (fp[kind][f] == m).all(), (inst.label, kind, f)
+    stale = dict(sj, footprints_version=1)
+    with pytest.raises(ValueError, match="footprint encoding"):
+        effects.footprints_from_json(stale)
+    with pytest.raises(ValueError, match="regenerate"):
+        effects.matrices_from_json(stale)
+
+
+def test_effects_differential_against_oracle_elementwise(effect_summary):
+    """Element-level soundness against the reference interpreter: every
+    ELEMENT a real oracle transition changes lies inside the traced
+    per-family element-wise write mask union."""
+    from raft_tla_tpu.models import oracle
+    summary, _ = effect_summary
+    fam_writes = {}
+    for inst in summary.instances:
+        masks = fam_writes.setdefault(inst.family, {})
+        for f, m in inst.writes.items():
+            masks[f] = masks.get(f, np.zeros_like(m)) | m
+    frontier, seen, checked = [init_state(DIMS)], set(), 0
+    for _level in range(3):
+        nxt = []
+        for s in frontier:
+            enc_s = encode_state(s, DIMS)
+            for (fam_code, _params), succ in oracle.successors(s, DIMS):
+                fam = DIMS.family_names[fam_code]
+                enc_t = encode_state(succ, DIMS)
+                for f in lane_map.FIELDS:
+                    delta = np.asarray(getattr(enc_s, f)) \
+                        != np.asarray(getattr(enc_t, f))
+                    if not delta.any():
+                        continue
+                    mask = fam_writes[fam].get(f)
+                    assert mask is not None and bool(
+                        (delta & ~mask).sum() == 0), (fam, f)
+                checked += 1
+                if succ not in seen and len(seen) < 300:
+                    seen.add(succ)
+                    nxt.append(succ)
+        frontier = nxt
+    assert checked > 100
+
+
+def test_resolve_passes_dependencies():
+    from raft_tla_tpu.analysis import resolve_passes
+    assert resolve_passes(("por",)) == ("effects", "por")
+    assert resolve_passes(("lint",)) == ("effects", "lint")
+    assert resolve_passes(("bounds",)) == ("bounds",)
+    assert resolve_passes(("por", "bounds")) == ("effects", "bounds", "por")
+    with pytest.raises(ValueError, match="typo"):
+        resolve_passes(("typo",))
+    with pytest.raises(ValueError):
+        resolve_passes(())

@@ -140,6 +140,39 @@ def test_bench_diff_flags_regressions(tmp_path, capsys):
     assert main([str(old), str(new), "--max-regress", "0.01"]) == 1
 
 
+def test_bench_diff_pruned_fraction_is_gated(tmp_path, capsys):
+    """The POR pruned fraction is a first-class compared metric: a
+    collapsed reduction (baseline pruned, candidate back to full
+    expansion) regresses; matched fractions pass with the note; runs
+    that never pruned stay silent on the axis."""
+    main = _bench_diff_main()
+    old, new = tmp_path / "old.json", tmp_path / "new.json"
+
+    def cov(pruned_t, pruned_r):
+        return {"Timeout": {"generated": 600, "distinct": 300,
+                            "disabled": 0, "pruned": pruned_t},
+                "Receive": {"generated": 400, "distinct": 100,
+                            "disabled": 200, "pruned": pruned_r}}
+
+    old.write_text(json.dumps(_fake_bench(coverage=cov(100, 50))))
+    new.write_text(json.dumps(_fake_bench(coverage=cov(100, 50))))
+    assert main([str(old), str(new)]) == 0
+    assert "POR pruned expansions" in capsys.readouterr().out
+    # Collapse to zero pruning -> regression past --pruned-drift.
+    new.write_text(json.dumps(_fake_bench(coverage=cov(0, 0))))
+    assert main([str(old), str(new)]) == 1
+    out = capsys.readouterr().out
+    assert "pruned fraction fell" in out
+    # ... but an explicit loose threshold lets it through.
+    assert main([str(old), str(new), "--pruned-drift", "50"]) == 0
+    capsys.readouterr()
+    # No pruning anywhere: the axis stays silent (legacy benches).
+    old.write_text(json.dumps(_fake_bench()))
+    new.write_text(json.dumps(_fake_bench()))
+    assert main([str(old), str(new)]) == 0
+    assert "POR pruned" not in capsys.readouterr().out
+
+
 def test_bench_diff_folds_mismatched_stage_granularities(tmp_path, capsys):
     """A v2 bench (classical stage keys) vs a v3 bench (fused-stage
     keys) must still diff: both sides fold to the common coarse stages
