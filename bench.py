@@ -143,6 +143,13 @@ def main():
     # BENCH_XLA_PROFILE_DIR (default artifacts/xla_profile).
     # Observational — the headline number is unaffected.
     xla_profile = int(os.environ.get("BENCH_XLA_PROFILE", "0"))
+    # Performance observatory (obs/perf.py; BENCH_PERF=0 disables):
+    # launch accounting + static roofline + fusion-advisor verdict,
+    # embedded as the bench JSON's "perf" block — what bench_diff.py
+    # gates with --launch-drift and bench_history.py renders with
+    # --perf.  Observational: the headline number is unaffected (the
+    # one-time jaxpr walk happens at engine build, before the clock).
+    perf_on = bool(int(os.environ.get("BENCH_PERF", "1")))
     cfg = EngineConfig(
         batch=int(os.environ.get("BENCH_BATCH",
                                  str(2048 if on_accel else 512))),
@@ -153,13 +160,16 @@ def main():
         max_seconds=BENCH_SECONDS,   # host-side; C++ store tracked separately)
         events_out=events_file,
         trace_out=os.environ.get("BENCH_TRACE_OUT"),
-        profile_chunks_every=profile_every or None,
+        # 0 passes through as explicitly-off so BENCH_PERF=1 cannot
+        # re-enable a profiler BENCH_PROFILE_CHUNKS=0 turned off.
+        profile_chunks_every=profile_every,
         xla_profile_chunks=xla_profile or None,
         xla_profile_dir=os.environ.get("BENCH_XLA_PROFILE_DIR",
                                        "artifacts/xla_profile"),
         pipeline=os.environ.get("BENCH_PIPELINE", "auto"),
         por=bool(int(os.environ.get("BENCH_POR", "0"))),
-        por_table=os.environ.get("BENCH_POR_TABLE"))
+        por_table=os.environ.get("BENCH_POR_TABLE"),
+        perf=perf_on)
     # "auto": on a multi-accelerator slice (e.g. v5e-8) the run shards
     # over all devices — the mesh engine is the product's scaling path
     # and the north-star target is defined on the full slice.
@@ -311,6 +321,12 @@ def main():
         # probability, per-level table, out-degree, seen-set load —
         # the semantic half of the trajectory the run ledger records.
         "report": res.report,
+        # Performance observatory (obs/perf.py): launch accounting,
+        # roofline rows with achieved-bandwidth fractions, and the
+        # fusion advisor's verdict — bench_diff.py gates
+        # launches_per_chunk (--launch-drift) and bandwidth drift on
+        # this block; {} when BENCH_PERF=0.
+        "perf": res.perf,
         "baseline_states_per_sec": round(base_rate, 1),
         "baseline_distinct": ores.distinct_states,
         "baseline_wall_s": round(base_wall, 2),

@@ -452,6 +452,17 @@ def main(argv=None):
                         "stage-budget table on stderr at run end.  "
                         "Observational: engine results are bit-identical "
                         "with profiling on or off")
+    c.add_argument("--perf", action="store_true",
+                   help="performance observatory (obs/perf.py): launch "
+                        "accounting over the real traced chunk program, "
+                        "static roofline with achieved-bandwidth "
+                        "fractions per chunk stage, and the fusion "
+                        "advisor naming the next fusion target — a "
+                        "'perf' event in --events-out, perf/* gauges, "
+                        "and a run-end table.  Implies --profile-chunks "
+                        "16 when no cadence is set.  Observational: "
+                        "engine results are bit-identical with perf on "
+                        "or off.  PERF directive is the cfg fallback")
     c.add_argument("--metrics-port", type=int, default=None,
                    metavar="PORT",
                    help="serve live telemetry over HTTP on 127.0.0.1:"
@@ -838,6 +849,7 @@ def main(argv=None):
             pipeline=resolve(args.pipeline, "PIPELINE", "auto"),
             por=bool(resolve(args.por or None, "POR", False)),
             por_table=resolve(args.por_table, "POR_TABLE", None),
+            perf=bool(resolve(args.perf or None, "PERF", False)),
             degrade_on_oom=not args.no_degrade,
             statespace_report=(False if args.no_report
                                else bool(resolve(None, "REPORT", True))),

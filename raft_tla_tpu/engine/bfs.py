@@ -201,10 +201,36 @@ class EngineConfig:
     # enqueue stage programs, accumulating chunk_stage/* histograms and
     # a run-end chunk_profile event + stage-budget table.  Observational
     # (the real fused chunk still does all the work — results are
-    # bit-identical profiling on or off); None disables.  Single-chip
-    # engine only; the mesh ignores it (its per-chip stages interleave
-    # collectives that a staged decomposition cannot fence honestly).
+    # bit-identical profiling on or off); None = unset (a --perf run
+    # then samples every 16th call), 0 = explicitly disabled (perf will
+    # not re-enable it).  Single-chip engine only; the mesh ignores it
+    # (its per-chip stages interleave collectives that a staged
+    # decomposition cannot fence honestly).
     profile_chunks_every: Optional[int] = None
+    # -- performance observatory (obs/perf.py, obs/roofline.py) --------
+    # ``perf=True`` builds the launch-accounting + static-roofline
+    # layer: the engine's REAL chunk program is traced once at build
+    # for the static launch model (device ops per batch, a pre-fusion
+    # upper bound — CI pins it per pipeline so a stage un-fusing can
+    # never land silently), the shared stage programs are traced for
+    # per-stage HBM-traffic floors, and the host loop feeds (batches,
+    # seconds) per chunk call.  At run end the ``perf`` event /
+    # ``EngineResult.perf`` / ``perf/*`` gauges carry launches-per-
+    # chunk, the launch tax priced against measured chunk time,
+    # achieved-bandwidth fractions per stage, and the fusion advisor's
+    # top candidate.  Observational: engine counts are bit-identical
+    # with perf on or off (tested).  Implies chunk profiling (the
+    # roofline's measured half): when profile_chunks_every is unset, a
+    # --perf run samples every 16th chunk call.
+    perf: bool = False
+    # Mesh skew telemetry (parallel/mesh.py): emit a ``skew`` warning
+    # event when the per-shard frontier imbalance (max/mean of this
+    # controller's shard next-level counts) reaches this ratio at a
+    # level boundary.  The balance gauges + level_complete fields are
+    # always on (a handful of host ints per level); only the warning
+    # threshold is configurable.  The collective-latency probe rides
+    # the ``perf`` flag instead (it costs a compile + a collective).
+    skew_warn_ratio: float = 2.0
     # Deadline for collecting sibling controllers' trace piece files at
     # replay (parallel/mesh.py _merge_trace_pieces).  None = auto: a 30 s
     # base plus a size-proportional allowance — the sibling of a large
@@ -326,6 +352,12 @@ class EngineResult:
     # (engine/explain.py write_counterexample): {"txt": ..., "json":
     # ..., "depth": n}, {} when no traced violation was rendered.
     counterexample: Dict = dataclasses.field(default_factory=dict)
+    # Performance observatory block (obs/perf.py; EngineConfig.perf):
+    # launch accounting, static roofline rows with achieved-bandwidth
+    # fractions, and the fusion advisor's verdict.  {} when perf is
+    # off; embedded in bench JSON and gated by scripts/bench_diff.py
+    # --launch-drift.
+    perf: Dict = dataclasses.field(default_factory=dict)
 
     @property
     def states_per_second(self) -> float:
@@ -561,8 +593,15 @@ class BFSEngine:
             self._xla_capture = None
         # Per-stage chunk profiler (obs/profile.py; --profile-chunks).
         # Rebuilt on re-entrant init: its stage programs are shaped by
-        # the (possibly halved) batch.
-        if cfg.profile_chunks_every:
+        # the (possibly halved) batch.  --perf implies sparse profiling
+        # (every 16th call) when no cadence was chosen: the roofline's
+        # achieved-bandwidth fractions need measured stage means.
+        # None = unset (perf may imply a cadence); 0 = explicitly OFF
+        # (BENCH_PROFILE_CHUNKS=0) — perf must not re-enable it.
+        prof_every = (cfg.profile_chunks_every
+                      if cfg.profile_chunks_every is not None
+                      else (16 if cfg.perf else None))
+        if prof_every:
             from ..obs import ChunkProfiler
             prof_k = compact_mod.choose_k(cfg.batch, dims.n_instances,
                                           cfg.compact_lanes)
@@ -581,7 +620,7 @@ class BFSEngine:
                 # NORTHSTAR budget rows stay comparable across PRs.
                 pipeline="v3" if cfg.pipeline == "v3" else "v1",
                 v3_force=cfg.v3_force_stages,
-                every=cfg.profile_chunks_every, metrics=self.metrics)
+                every=prof_every, metrics=self.metrics)
         else:
             self._profiler = None
         if cfg.checkpoint_dir:
@@ -817,6 +856,36 @@ class BFSEngine:
 
         self._chunk = jax.jit(chunk, donate_argnums=(3, 5, 6))
         self._ingest = jax.jit(ingest, donate_argnums=(2, 4))
+        # Performance observatory (obs/perf.py; EngineConfig.perf):
+        # trace THE chunk program just built — the exact jaxpr the jit
+        # above compiles, v2/v3/POR/fused-tail included — for the
+        # static launch model, plus the shared stage programs for the
+        # roofline traffic floors.  Fail-soft: a model that cannot be
+        # built (exotic jaxpr the walk has no rule for) degrades to a
+        # null perf block at run end, never a failed engine build.
+        self._perf = None
+        if cfg.perf:
+            from ..obs import perf as perf_mod
+            i32 = jax.ShapeDtypeStruct((), jnp.int32)
+            qav = jax.ShapeDtypeStruct((Q + PAD, sw), jnp.uint8)
+            seen_av = jax.eval_shape(
+                lambda: fpset.empty(self._seen_cap))
+            ta = TQ + K if record_static else 8
+            tbuf_av = tuple(
+                jax.ShapeDtypeStruct((ta,), d)
+                for d in (jnp.uint32, jnp.uint32, jnp.uint32,
+                          jnp.uint32, _I32))
+            self._perf = perf_mod.build_accounting(
+                pipeline=("v3" if self._v3_plan is not None
+                          else "v2" if self._v2 is not None
+                          else "v1"),
+                chunk_fn=chunk,
+                chunk_avals=(qav, i32, i32, qav, i32, seen_av,
+                             tbuf_av, i32, i32),
+                dims=dims, B=B, K=K,
+                compact_method=cfg.compact_method,
+                v3_force=cfg.v3_force_stages, plan=self._v3_plan,
+                metrics=self.metrics)
         self._fp_rows = jax.jit(fp_rows)
         self._expand1 = jax.jit(expand)
         self._fp_batch = jax.jit(jax.vmap(fingerprint))
@@ -932,6 +1001,13 @@ class BFSEngine:
         prof = getattr(self, "_profiler", None)
         if prof is not None:
             prof.reset()            # warm engines: samples are per-run
+        pf = getattr(self, "_perf", None)
+        if pf is not None:
+            pf.reset()              # launch/level accumulators per run
+        # Device-HBM watermark (level-correlated OOM evidence): per-run
+        # high-water mark, re-armed here so a warm shared registry
+        # never carries a previous run's peak into this run's levels.
+        self._hbm_watermark = 0
         if self.tracer.enabled:
             self.tracer.reset()     # one trace file = one run
         # Black box armed before the first event so run_start itself is
@@ -1042,6 +1118,20 @@ class BFSEngine:
                 if res is not None:
                     res.chunk_stages = prof.stage_means()
                 prof.finish(evlog)
+            # Performance observatory (obs/perf.py): assemble the perf
+            # block AFTER the profiler lands its means (the roofline's
+            # measured half), emit the ``perf`` event + gauges, print
+            # the run-end table.  Skipped on error exits — a crashed
+            # run's perf numbers would price a partial loop.
+            pf = getattr(self, "_perf", None)
+            if pf is not None and err is None and res is not None:
+                try:
+                    res.perf = pf.finish(evlog,
+                                         chunk_stages=res.chunk_stages)
+                except Exception as e:
+                    import sys as _sys
+                    print(f"perf: block assembly failed "
+                          f"({type(e).__name__}: {e})", file=_sys.stderr)
             # Device-profiler window: close it (early-exit runs) and
             # land the xla_profile event whether the run lived or died.
             cap = getattr(self, "_xla_capture", None)
@@ -1140,12 +1230,40 @@ class BFSEngine:
             self.tracer.write()
         self._lvl_t0 = time.perf_counter()
         evlog = self._evlog
+        # Launch accounting level boundary (obs/perf.py): snapshot this
+        # level's launch total so OOM/skew events correlate with launch
+        # pressure per level.
+        pf = getattr(self, "_perf", None)
+        if pf is not None:
+            pf.end_level(res.diameter)
+        # Per-level device-HBM watermark: run_end's one-shot
+        # devices_memory probe cannot say WHICH level drove the peak —
+        # sampling here lets an OOM-degradation event be correlated
+        # with the level that caused it.  Caveat jaxlib semantics:
+        # ``peak_bytes_in_use`` is a PROCESS-LIFETIME allocator peak
+        # (a warm engine inherits a bigger previous run's value and
+        # the column then never moves), so the per-level CURRENT
+        # ``bytes_in_use`` is recorded alongside it — within one run
+        # the peak column says where the high-water rose, and on warm
+        # processes the bytes_in_use series is the level-correlatable
+        # signal.  CPU/virtual devices report no stats: the fields
+        # stay None, the gauge untouched.
+        mem = device_memory_stats()
+        hbm_peak = mem.get("peak_bytes_in_use")
+        if hbm_peak is not None:
+            self._hbm_watermark = max(
+                getattr(self, "_hbm_watermark", 0), int(hbm_peak))
+            self.metrics.gauge("engine/device_hbm_peak_bytes",
+                               self._hbm_watermark)
+        # Mesh skew telemetry (parallel/mesh.py stamps _last_skew just
+        # before the boundary; None on the single-chip engine).
+        skew = getattr(self, "_last_skew", None)
         # Level snapshot for the statespace report's per-level table
         # (obs/report.py): frontier width + cumulative counters + the
         # seen-set gauges the chunk loop keeps current.  Host-side dict
         # appends — observational by construction.
         if self.config.statespace_report:
-            res.level_stats.append({
+            row = {
                 "level": res.diameter,
                 "frontier": int(frontier_rows),
                 "distinct": res.distinct,
@@ -1153,7 +1271,17 @@ class BFSEngine:
                 "seen_size": int(self.metrics.gauge_value(
                     "engine/seen_size")),
                 "seen_capacity": int(self.metrics.gauge_value(
-                    "engine/seen_capacity"))})
+                    "engine/seen_capacity")),
+                "hbm_peak_bytes": (int(hbm_peak)
+                                   if hbm_peak is not None else None),
+                "hbm_bytes_in_use": (int(mem["bytes_in_use"])
+                                     if mem.get("bytes_in_use")
+                                     is not None else None)}
+            if skew is not None:
+                row["frontier_skew"] = skew.get("frontier_skew")
+                row["seen_skew"] = skew.get("seen_skew")
+                row["shard_frontier"] = skew.get("shard_frontier")
+            res.level_stats.append(row)
         # No enabled-check: emit() mirrors every event into the flight
         # ring even on a file-less log, and the watch console's level
         # rows come from exactly this record.  The per-level phase_delta
@@ -1161,13 +1289,18 @@ class BFSEngine:
         phases = phase_delta(self.metrics.phase_seconds(),
                              self._phase_base)
         elapsed = evlog.elapsed()
+        extra = {}
+        if skew is not None:
+            extra = {"frontier_skew": skew.get("frontier_skew"),
+                     "seen_skew": skew.get("seen_skew"),
+                     "shard_frontier": skew.get("shard_frontier")}
         evlog.emit(
             "level_complete", level=res.diameter,
             frontier_rows=frontier_rows, distinct=res.distinct,
             generated=res.generated, phase_seconds=phases,
             unattributed_seconds=round(
                 elapsed - sum(phases.values()), 6),
-            memory=device_memory_stats())
+            memory=mem, **extra)
 
     def _run_impl(self, init_states: Optional[List[PyState]] = None,
                   resume=None) -> EngineResult:
@@ -1570,6 +1703,12 @@ class BFSEngine:
                     # the dispatch above overlapped.
                     with mt.phase_timer("stats_fetch"):
                         st = np.asarray(out[3])
+                    if self._perf is not None and int(st[1]):
+                        # Launch accounting's dynamic half: batches +
+                        # measured seconds for this chunk call — host
+                        # arithmetic on values already fetched.
+                        self._perf.add_chunk(int(st[1]),
+                                             time.time() - t_call)
                     if int(st[1]):       # st fetch synced: timing is real
                         per = (time.time() - t_call) / int(st[1])
                         # Conservative estimator: jumps up to the latest

@@ -83,6 +83,7 @@ def engine_config_from_backend(setup: CheckSetup) -> EngineConfig:
         pipeline=be.get("PIPELINE", EngineConfig.pipeline),
         por=bool(be.get("POR", False)),
         por_table=be.get("POR_TABLE"),
+        perf=bool(be.get("PERF", False)),
         statespace_report=bool(be.get("REPORT", True)),
         counterexample_dir=be.get("COUNTEREXAMPLE_DIR"))
 

@@ -30,6 +30,12 @@ One spine, several legs:
   probability, per-level frontier table, out-degree, seen-set load)
   assembled host-side at run end: the ``statespace`` event,
   ``EngineResult.report``, and the TLC-style stderr block;
+- :mod:`.perf` / :mod:`.roofline` — the **performance observatory**
+  (``--perf``): static launch accounting over the engines' real traced
+  chunk programs, per-stage HBM-traffic floors joined with the
+  ChunkProfiler's measured means into achieved-bandwidth fractions,
+  and the fusion advisor naming the next fusion target (the ``perf``
+  run event, ``EngineResult.perf``, ``perf/*`` gauges);
 - :mod:`.history` — the append-only JSONL **run-history ledger**
   (``check --history`` / ``HISTORY`` directive / ``BENCH_HISTORY``):
   per-run cfg/model/host fingerprints, verdict, rates, and report
@@ -59,6 +65,15 @@ from .expose import (parse_prometheus, render_prometheus,        # noqa: F401
 from .report import (build_report, collision_probability,        # noqa: F401
                      render_report)
 from . import history                                            # noqa: F401
+# NOTE deliberately NOT imported here: obs.perf / obs.roofline (the
+# performance observatory).  Importing them at package init would put
+# two new modules into the import-time heap history of EVERY test and
+# tool that touches obs — and jaxlib's CPU client is heap-layout
+# fragile under the big mesh tests (the tests/conftest.py reorder
+# rationale), so new modules stay off the default import path as a
+# precaution.  Consumers import them lazily:
+# ``from raft_tla_tpu.obs import perf`` /
+# ``from raft_tla_tpu.obs import roofline`` at use sites.
 # .profile imports jax lazily but pulls model/ops modules at call time;
 # import the classes here for the one-stop namespace (still jax-free at
 # import).

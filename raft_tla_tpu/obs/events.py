@@ -53,12 +53,19 @@ KNOWN_EVENTS = (
     # ``counterexample_path`` when a traced violation was rendered
     # (engine/explain.py).
     "statespace",       # TLC-parity run report; payload: "report"
+    # Performance observatory (obs/perf.py, obs/roofline.py): launch
+    # accounting + static roofline + fusion-advisor verdict, one per
+    # completed --perf run; and the mesh's per-shard balance warning
+    # (parallel/mesh.py skew telemetry).
+    "perf",             # launch/roofline/advisor block; payload: "perf"
+    "skew",             # shard imbalance warning; payload: "balance"
 )
 
 #: Structured payload field each new event type must carry.
 _EVENT_PAYLOAD_FIELDS = {"chunk_profile": "stages", "coverage": "actions",
                          "postmortem": "dump", "watch_attach": "client",
-                         "xla_profile": "capture", "statespace": "report"}
+                         "xla_profile": "capture", "statespace": "report",
+                         "perf": "perf", "skew": "balance"}
 
 
 #: memory_stats() keys kept in event payloads (one extraction for the

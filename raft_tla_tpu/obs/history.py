@@ -224,14 +224,30 @@ def resolve_baseline(path: str, host_fp: Optional[dict],
     return None
 
 
-def render_table(entries: List[dict]) -> str:
+def perf_columns(entry: dict):
+    """(launches/chunk, advisor-top) from an entry's embedded bench
+    perf block (obs/perf.py) — or the xplane_summary dialect, which
+    embeds the same block shape.  (None, None) for entries predating
+    the metric, so the trajectory renders '--' instead of guessing."""
+    bench = entry.get("bench") or {}
+    perf = bench.get("perf") or {}
+    lpc = (perf.get("launch") or {}).get("launches_per_chunk")
+    top = (perf.get("advisor") or {}).get("top")
+    return lpc, top
+
+
+def render_table(entries: List[dict], perf: bool = False) -> str:
     """The trajectory table (scripts/bench_history.py): one row per
     entry, host-key column + explicit flags where adjacent entries are
     NOT rate-comparable (different or unknown host) — the r05 trap,
-    rendered impossible to miss."""
+    rendered impossible to miss.  ``perf=True`` adds the performance-
+    observatory columns (launches/chunk + advisor pick) so the
+    trajectory shows whether fusion work is actually RETIRING launches
+    across rounds, not just moving wall-clock."""
+    pcols = (f" {'launch/chunk':>12s} {'advisor':14s}") if perf else ""
     lines = [f"{'#':>3s} {'label':20s} {'kind':9s} {'host':10s} "
              f"{'distinct/s':>12s} {'distinct':>12s} {'diam':>5s} "
-             f"{'verdict':10s} flags"]
+             f"{'verdict':10s}{pcols} flags"]
     first = object()
     prev_key = first              # sentinel: first row never flags
     warnings = []
@@ -257,8 +273,13 @@ def render_table(entries: List[dict]) -> str:
                   else f" {'--':>12s}")
                + (f" {dia:5d}" if isinstance(dia, int)
                   else f" {'--':>5s}")
-               + f" {str(e.get('verdict') or '?'):10s} "
-               + (",".join(flags) if flags else "-"))
+               + f" {str(e.get('verdict') or '?'):10s}")
+        if perf:
+            lpc, top = perf_columns(e)
+            row += ((f" {lpc:12,.0f}" if isinstance(lpc, (int, float))
+                     else f" {'--':>12s}")
+                    + f" {str(top or '--'):14s}")
+        row += " " + (",".join(flags) if flags else "-")
         lines.append(row)
         prev_key = key
     for w in warnings:

@@ -49,6 +49,15 @@ class V3Plan(NamedTuple):
     compactor: Optional[Callable]   # Pallas compactor, or None = XLA
     tail: Optional[Callable]     # fused insert+enqueue, or None = split
     enqueue_method: str          # chunk-body enqueue when tail is None
+    # Expected kernel launches per stage, per batch: a Pallas/fused
+    # stage is exactly ONE kernel (the fused insert+enqueue pair share
+    # it); an XLA stage is None here — its pre-fusion device-op count
+    # comes from the launch model's jaxpr walk (obs/perf.py), which
+    # this plan cannot know without the model's kernels.  Makes the
+    # fused-vs-unfused launch delta first-class on EngineResult.perf.
+    # Default None, not {}: a NamedTuple field default is CLASS-level,
+    # so a dict here would be shared (and mutable) across instances.
+    launches: Optional[Dict[str, Optional[int]]] = None
 
 
 def describe(plan: V3Plan) -> str:
@@ -185,8 +194,21 @@ def resolve_plan(B: int, G: int, K: int, *, Q: int, sw: int = 8,
                                       f"build/probe: {type(e).__name__}: "
                                       f"{str(e)[:160]}")
                 enq = enqueue_method
+    # Expected launches per stage (obs/perf.py consumes this): each
+    # resolved Pallas kernel is exactly one launch; the fused tail is
+    # ONE kernel covering insert+enqueue (so enqueue's own count is 0
+    # when fused — summing the dict never double-prices the pair); XLA
+    # stages are None (their pre-fusion op count is the launch model's
+    # to derive from the traced jaxpr).
+    launches: Dict[str, Optional[int]] = {s: None for s in STAGES}
+    if stages["compact"] == "pallas":
+        launches["compact"] = 1
+    if stages["insert"] == "fused":
+        launches["insert"], launches["enqueue"] = 1, 0
+    elif stages["enqueue"] == "pallas":
+        launches["enqueue"] = 1
     return V3Plan(stages=stages, reasons=reasons, compactor=compactor,
-                  tail=tail, enqueue_method=enq)
+                  tail=tail, enqueue_method=enq, launches=launches)
 
 
 def _probe_enqueue(K: int, sw: int, interpret: bool) -> None:

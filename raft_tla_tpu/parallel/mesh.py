@@ -428,6 +428,36 @@ class MeshBFSEngine:
             in_specs=(sx, sx, sx, sx, sx, sx, sx, sx, sx),
             out_specs=(sx, sx, sx, sx, sx, (sx,) * 5, sx, rep, rep, rep)),
             donate_argnums=(2, 4, 5, 6, 7))
+        # Performance observatory (obs/perf.py; EngineConfig.perf):
+        # launch model from THE sharded chunk program just built — the
+        # walk recurses through shard_map, so collectives (all_to_all
+        # owner routing, psum'd stats) are counted per batch alongside
+        # the device ops.  The roofline's per-stage measured half is a
+        # single-chip instrument (the profiler rationale above), so the
+        # mesh block carries launch accounting + the modeled collective
+        # share, not stage fractions.  Fail-soft like the single-chip
+        # engine.
+        self._last_skew = None
+        self._perf = None
+        if cfg.perf:
+            from ..obs import perf as perf_mod
+            i32s = jax.ShapeDtypeStruct((n,), _I32)
+            scalar = jax.ShapeDtypeStruct((), _I32)
+            qav = jax.ShapeDtypeStruct((n, QL + PAD, sw), jnp.uint8)
+            sh_av = jax.ShapeDtypeStruct((n, self._CL), _U32)
+            tbuf_av = tuple(
+                jax.ShapeDtypeStruct((n, self._TA), d)
+                for d in (jnp.uint32, jnp.uint32, jnp.uint32,
+                          jnp.uint32, _I32))
+            self._perf = perf_mod.build_accounting(
+                pipeline=("v3" if self._v3_plan is not None
+                          else "v2" if self._v2 is not None
+                          else "v1"),
+                chunk_fn=self._chunk,
+                chunk_avals=(qav, i32s, scalar, qav, i32s, sh_av,
+                             sh_av, i32s, tbuf_av, i32s, scalar),
+                plan=self._v3_plan, with_stages=False,
+                metrics=self.metrics, engine="mesh")
 
         def fp_rows(rows):
             return jax.vmap(fingerprint)(
@@ -564,6 +594,71 @@ class MeshBFSEngine:
     def _emit_level_event(self, res, frontier_rows):
         from ..engine.bfs import BFSEngine
         BFSEngine._emit_level_event(self, res, frontier_rows)
+
+    def _sample_skew(self, res, next_counts, ssize) -> None:
+        """Per-shard balance telemetry, sampled at each level boundary
+        (ROADMAP item 5's first observability surface): this
+        controller's shard next-level counts and seen-set sizes ->
+        ``mesh/*`` balance gauges, skew fields on the level_complete
+        event (via ``_last_skew``, read by the shared emit), and a
+        ``skew`` WARNING event when max/mean frontier imbalance reaches
+        ``EngineConfig.skew_warn_ratio``.  Host-side reads of a handful
+        of addressable-shard ints per level — observational by
+        construction (bit-identity asserted in tests/test_perf.py).
+        Caveats: under a process group each controller samples its own
+        shards (the union is the global picture, one event log piece
+        each); a level whose rows were already drained to the host pool
+        samples the device-resident remainder only.
+
+        With ``--perf`` on, also times one psum agreement round (the
+        collective-latency probe behind the perf block's modeled
+        collective share) — that half is gated: it costs a compile +
+        a collective round, unlike the free shard reads."""
+        try:
+            fr = self._local_counts(next_counts)
+            sz = self._local_counts(ssize)
+        except Exception:
+            self._last_skew = None
+            return
+        vals = [int(v) for _k, v in sorted(fr.items())]
+        sizes = [int(v) for _k, v in sorted(sz.items())]
+
+        def ratio(xs):
+            mean = sum(xs) / len(xs) if xs else 0.0
+            return round(max(xs) / mean, 4) if mean > 0 else None
+
+        fsk, ssk = ratio(vals), ratio(sizes)
+        mt = self.metrics
+        if vals:
+            mt.gauge("mesh/shard_frontier_max", max(vals))
+            mt.gauge("mesh/shard_frontier_min", min(vals))
+        if fsk is not None:
+            mt.gauge("mesh/frontier_skew", fsk)
+        if sizes:
+            mt.gauge("mesh/shard_seen_max", max(sizes))
+        if ssk is not None:
+            mt.gauge("mesh/seen_skew", ssk)
+        self._last_skew = {"frontier_skew": fsk, "seen_skew": ssk,
+                           "shard_frontier": vals, "shard_seen": sizes}
+        thr = self.config.skew_warn_ratio
+        if fsk is not None and thr and fsk >= thr:
+            mt.counter("mesh/skew_warnings")
+            self._evlog.emit("skew", balance={
+                "level": res.diameter, "frontier_skew": fsk,
+                "seen_skew": ssk, "shard_frontier": vals,
+                "threshold": thr})
+        if self._perf is not None:
+            try:
+                if not hasattr(self, "_psum_probe"):
+                    from . import multihost as mh
+                    self._psum_probe = mh.build_sum(self.mesh)
+                    self._psum_probe(1)   # warm once: compile off the
+                from ..obs import perf as perf_mod  # timed samples
+                self._perf.note_collective_probe(
+                    perf_mod.timed_collective_probe(self._psum_probe, 1,
+                                                    warm=False))
+            except Exception:
+                pass                 # the probe is a nicety, never fatal
 
     def _counterexample_base(self) -> str:
         """Per-controller counterexample file stem (the event-log piece
@@ -923,6 +1018,7 @@ class MeshBFSEngine:
             # per-chip convention as the chunk loop's gauge updates.
             mt.gauge("engine/seen_capacity", self._CL)
             mt.gauge("engine/seen_size", int(ist[6]))
+            self._sample_skew(res, next_counts, ssize)
             self._emit_level_event(res, level_rows)
             qcur, qnext = qnext, qcur
             cur_counts_dev = next_counts
@@ -1023,6 +1119,11 @@ class MeshBFSEngine:
                     # time.
                     with mt.phase_timer("stats_fetch"):
                         st = np.asarray(stats)
+                    if self._perf is not None and int(st[1]):
+                        # Launch accounting's dynamic half (obs/perf.py)
+                        # — host arithmetic on the fetched stats only.
+                        self._perf.add_chunk(int(st[1]),
+                                             time.time() - t_call)
                     if int(st[1]):
                         per = (time.time() - t_call) / int(st[1])
                         # Conservative: jump up instantly, decay slowly
@@ -1201,6 +1302,7 @@ class MeshBFSEngine:
             res.diameter += 1
             level_rows = drained + cur_sum
             res.levels.append(level_rows)
+            self._sample_skew(res, next_counts, ssize)
             self._emit_level_event(res, level_rows)
             qcur, qnext = qnext, qcur
             cur_counts_dev = next_counts

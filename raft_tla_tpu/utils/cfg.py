@@ -44,7 +44,9 @@ obs/report.py; TRUE by default — FALSE drops every report surface),
 COUNTEREXAMPLE_DIR (where a traced violation's rendered counterexample
 lands, engine/explain.py; defaults next to CHECKPOINT_DIR), HISTORY
 (append one run-history ledger entry per run to this JSONL file,
-obs/history.py).
+obs/history.py), PERF (the performance observatory: launch accounting,
+static roofline + fusion advisor, obs/perf.py — observational, implies
+sparse chunk profiling).
 Precedence everywhere: CLI flag > cfg backend key > built-in default.
 """
 
@@ -95,7 +97,7 @@ _BACKEND_KEYS = {
     "SPILL_DIR", "TRACE_DIR", "PROGRESS_SECONDS", "EVENTS_OUT",
     "KEEP_CHECKPOINTS", "TRACE_OUT", "PROFILE_CHUNKS", "POR", "POR_TABLE",
     "PIPELINE", "XLA_PROFILE", "METRICS_PORT", "REPORT",
-    "COUNTEREXAMPLE_DIR", "HISTORY",
+    "COUNTEREXAMPLE_DIR", "HISTORY", "PERF",
 }
 
 

@@ -36,6 +36,12 @@ BENCH_r01–r05 files predate chunk_stages/coverage and still diff):
   fingerprint, tail (dedup_insert+enqueue | insert_enqueue), total —
   with a note, instead of silently comparing an empty intersection
   (or refusing the diff).
+- performance observatory (``perf`` block, obs/perf.py — also the
+  ``scripts/xplane_summary.py`` dialect): ``launches_per_chunk`` rising
+  past ``--launch-drift`` regresses (a stage un-fusing is visible
+  before any wall-clock moves), as does a stage's achieved-bandwidth
+  fraction falling by the same margin; one side predating the block
+  folds to a note.
 - POR pruned fraction (``pruned / (pruned + generated)`` from the
   coverage object): compared whenever either side pruned anything; a
   candidate whose fraction falls more than ``--pruned-drift`` points
@@ -78,10 +84,16 @@ def load_bench(path: str) -> dict:
         raise ValueError(f"{path}: cannot load bench JSON: {e}")
     if isinstance(data, dict) and "parsed" in data:
         data = data["parsed"]           # BENCH_rNN wrapper
-    if not isinstance(data, dict) or "value" not in data:
+    # "value" is the classic bench headline; a perf-only document (the
+    # scripts/xplane_summary.py dialect: measured launch counts from
+    # device-profiler artifacts, no states/s headline) diffs too — the
+    # headline axis simply has nothing to compare.
+    if not isinstance(data, dict) or ("value" not in data
+                                      and "perf" not in data):
         raise ValueError(
-            f"{path}: not a bench result (no 'value' field; a BENCH_r* "
-            f"wrapper whose run emitted no JSON has parsed=null)")
+            f"{path}: not a bench result (no 'value' or 'perf' field; a "
+            f"BENCH_r* wrapper whose run emitted no JSON has "
+            f"parsed=null)")
     return data
 
 
@@ -248,6 +260,56 @@ def diff_stages(old: dict, new: dict, d: Diff, max_regress: float):
                       f"{nc * 1e3:.2f} ms/batch")
 
 
+def diff_perf(old: dict, new: dict, d: Diff, launch_drift: float):
+    """Performance-observatory axis (obs/perf.py ``perf`` block, also
+    the scripts/xplane_summary.py dialect): launches_per_chunk rising
+    more than ``--launch-drift`` (fractional) regresses — a stage
+    un-fusing shows up here before any wall-clock number moves — and a
+    stage's achieved-bandwidth fraction falling by more than the same
+    fraction regresses too.  Folds gracefully when one side predates
+    the metric (legacy BENCH_r* files): reported, never gated."""
+    op, np_ = old.get("perf") or {}, new.get("perf") or {}
+    if not op and not np_:
+        return
+    if not op or not np_:
+        side = "baseline" if not op else "candidate"
+        have = np_ if np_ else op
+        lpc = (have.get("launch") or {}).get("launches_per_chunk")
+        d.note(f"perf block present on one side only ({side} predates "
+               f"it); launches/chunk "
+               + (f"{lpc:,.0f}" if lpc is not None else "unknown")
+               + " not gated")
+        return
+    ol = (op.get("launch") or {}).get("launches_per_chunk")
+    nl = (np_.get("launch") or {}).get("launches_per_chunk")
+    if ol is not None and nl is not None:
+        pct = (nl - ol) / ol * 100.0 if ol else 0.0
+        d.note(f"launches/chunk: {ol:,.0f} -> {nl:,.0f} ({pct:+.1f}%)")
+        if ol > 0 and nl > ol * (1.0 + launch_drift):
+            d.regress(f"launches_per_chunk rose {pct:.1f}% "
+                      f"(> {launch_drift:.0%} allowed): {ol:,.0f} -> "
+                      f"{nl:,.0f} — a stage un-fused or the chunk "
+                      f"program grew kernels")
+    osr = ((op.get("roofline") or {}).get("stages")) or {}
+    nsr = ((np_.get("roofline") or {}).get("stages")) or {}
+    for stage in sorted(set(osr) & set(nsr)):
+        of = osr[stage].get("bandwidth_fraction")
+        nf = nsr[stage].get("bandwidth_fraction")
+        if of is None or nf is None:
+            continue
+        d.note(f"achieved bandwidth {stage}: {of:.2%} -> {nf:.2%} "
+               f"of peak")
+        if of > 0 and nf < of * (1.0 - launch_drift):
+            d.regress(f"achieved-bandwidth fraction of '{stage}' fell "
+                      f"{(of - nf) / of:.0%} (> {launch_drift:.0%} "
+                      f"allowed): {of:.2%} -> {nf:.2%} of peak")
+    oa = (op.get("advisor") or {}).get("top")
+    na = (np_.get("advisor") or {}).get("top")
+    if oa or na:
+        d.note(f"fusion advisor top candidate: {oa or '-'} -> "
+               f"{na or '-'}")
+
+
 def pruned_fraction(cov: dict):
     """(pruned count, pruned share of attempted expansions in %) from a
     coverage object — the POR reduction's first-class metric."""
@@ -383,6 +445,12 @@ def main(argv=None) -> int:
                    help="allowed absolute drift (percentage points) in "
                         "any action's share of generated states "
                         "(default 5.0)")
+    p.add_argument("--launch-drift", type=float, default=0.25,
+                   help="allowed fractional rise in launches_per_chunk "
+                        "(and fall in per-stage achieved-bandwidth "
+                        "fraction) from the perf block (obs/perf.py; "
+                        "default 0.25).  Only gated when BOTH benches "
+                        "carry the block — legacy files fold to a note")
     p.add_argument("--pruned-drift", type=float, default=1.0,
                    help="allowed drop (percentage points) in the POR "
                         "pruned fraction (pruned/(pruned+generated)) "
@@ -421,6 +489,7 @@ def main(argv=None) -> int:
     diff_headline(old, new, d, args.max_regress)
     diff_phases(old, new, d, args.phase_max_regress, args.phase_floor)
     diff_stages(old, new, d, args.stage_max_regress)
+    diff_perf(old, new, d, args.launch_drift)
     diff_pruned(old, new, d, args.pruned_drift)
     diff_coverage(old, new, d, args.coverage_drift)
     return d.render()

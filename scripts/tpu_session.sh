@@ -191,6 +191,24 @@ if probe; then
     done
     ls -R artifacts/xla_profile_v2 artifacts/xla_profile_v3 2>/dev/null \
         | head -20
+    # XPlane ingestion (scripts/xplane_summary.py): fold each capture's
+    # Perfetto trace into the perf JSON dialect and into the run ledger
+    # (kind=xplane), so the measured launches/chunk lands next to the
+    # bench trajectory instead of staying a profiler screenshot —
+    # bench_diff --launch-drift can then gate v2-vs-v3 on MEASURED
+    # launch counts.
+    for pipe in v2 v3; do
+        python scripts/xplane_summary.py "artifacts/xla_profile_${pipe}" \
+            --out "artifacts/xplane_summary_${pipe}.json" \
+            --history artifacts/history.jsonl \
+            --label "xplane_${pipe}" \
+            || echo "xplane summary ${pipe} failed (rc=$?)"
+    done
+    python scripts/bench_diff.py artifacts/xplane_summary_v2.json \
+        artifacts/xplane_summary_v3.json \
+        | tee artifacts/xplane_v2_vs_v3.txt \
+        || echo "xplane v2-vs-v3 launch diff: rc=$? (1 = launch "\
+"regression verdict, 2 = unreadable capture)"
 else
     echo "skipped: tunnel dead"
 fi
