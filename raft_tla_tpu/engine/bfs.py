@@ -243,6 +243,13 @@ class EngineConfig:
     # checkpoint_dir; with neither set the dump is disabled (the
     # in-memory flight ring still feeds watch/metrics-port attach).
     postmortem_dir: Optional[str] = None
+    # Extra key/values merged into the flight recorder's ``run_context``
+    # record when the run arms (serving/: the job manager tags each
+    # server-executed run with ``{"job_id": ..., "tenant": ...}`` so
+    # ring snapshots, watch consoles, and postmortem dumps attribute
+    # device time to the job that spent it).  Host-side only — safe to
+    # set per-request on a warm cached engine, like the budgets.
+    run_context_extra: Optional[dict] = None
     # Device-profiler capture (obs/profile.py XlaProfileCapture;
     # --xla-profile[=N] / XLA_PROFILE directive): bracket the first N
     # chunk calls of the run in a jax.profiler trace window, correlated
@@ -1024,7 +1031,10 @@ class BFSEngine:
                              is not None else "v1"),
                 "fused_stages": (dict(self._v3_plan.stages)
                                  if getattr(self, "_v3_plan", None)
-                                 is not None else {})})
+                                 is not None else {}),
+                # Caller-attributed identity (job/tenant tags from the
+                # serving layer) rides the same context record.
+                **dict(cfg.run_context_extra or {})})
         _FLIGHT.set_live_evlog(evlog)
         # Device-profiler capture is per-run (the window opens at the
         # first chunk call, after warm-up compilation).

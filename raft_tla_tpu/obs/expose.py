@@ -18,11 +18,15 @@ service can sit behind a stock scrape config with zero glue:
   exposition is valid" without installing a Prometheus client).
 - :func:`serve_metrics` / :func:`start_metrics_server` — a tiny
   threaded HTTP listener (``--metrics-port`` on the CLI,
-  ``BENCH_METRICS_PORT`` on the bench) with two endpoints:
-  ``/metrics`` (the exposition — point a scraper here) and ``/flight``
-  (the flight recorder's ring as JSON — what ``python -m raft_tla_tpu
-  watch http://host:port`` polls for a live console on a plain check
-  run that has no checker service in front of it).
+  ``BENCH_METRICS_PORT`` on the bench, ``--metrics-port`` on the
+  checker service) with three endpoints: ``/metrics`` (the exposition —
+  point a scraper here), ``/flight`` (the flight recorder's ring as
+  JSON — what ``python -m raft_tla_tpu watch http://host:port`` polls
+  for a live console on a plain check run that has no checker service
+  in front of it), and ``/jobs`` (the serving layer's job registry as
+  JSON, when the host process wired a ``jobs_provider`` — the checker
+  service does, so one GET shows the queue a scraper's gauges
+  summarize).
 
 Zero-dependency and jax-free, like the rest of ``obs/`` (the registry
 must stay exposable from tooling that never touches a device).
@@ -289,8 +293,17 @@ class _MetricsHandler(BaseHTTPRequestHandler):
                     doc = {"ok": False, "error": "no flight recorder"}
                 body = (json.dumps(doc, default=str) + "\n").encode()
                 ctype = "application/json"
+            elif self.path.split("?")[0] == "/jobs":
+                provider = self.server.jobs_provider
+                if provider is not None:
+                    doc = {"ok": True}
+                    doc.update(provider())
+                else:
+                    doc = {"ok": False, "error": "no job manager"}
+                body = (json.dumps(doc, default=str) + "\n").encode()
+                ctype = "application/json"
             elif self.path.split("?")[0] == "/":
-                body = b"raft_tla_tpu metrics: /metrics /flight\n"
+                body = b"raft_tla_tpu metrics: /metrics /flight /jobs\n"
                 ctype = "text/plain"
             else:
                 self.send_error(404)
@@ -317,6 +330,10 @@ class MetricsHTTPServer(ThreadingHTTPServer):
     allow_reuse_address = True
     registry = None
     flight = None
+    #: Zero-arg callable returning the /jobs document (the serving
+    #: layer's ``JobManager.jobs_doc``); None = endpoint answers
+    #: ``{"ok": false}`` (a plain check run has no job registry).
+    jobs_provider = None
     labels: Optional[Dict[str, str]] = None
 
     def __init__(self, *args, **kw):
@@ -328,27 +345,29 @@ class MetricsHTTPServer(ThreadingHTTPServer):
 
 def serve_metrics(port: int, registry, flight=None,
                   host: str = "127.0.0.1",
-                  labels: Optional[Dict[str, str]] = None
-                  ) -> MetricsHTTPServer:
+                  labels: Optional[Dict[str, str]] = None,
+                  jobs_provider=None) -> MetricsHTTPServer:
     """Create (not start) the listener; port 0 picks an ephemeral port
     (``server_address[1]``).  Same trust model as the checker service:
     unauthenticated, loopback by default."""
     srv = MetricsHTTPServer((host, port), _MetricsHandler)
     srv.registry = registry
     srv.flight = flight
+    srv.jobs_provider = jobs_provider
     srv.labels = labels if labels is not None else default_labels()
     return srv
 
 
 def start_metrics_server(port: int, registry, flight=None,
                          host: str = "127.0.0.1",
-                         labels: Optional[Dict[str, str]] = None
+                         labels: Optional[Dict[str, str]] = None,
+                         jobs_provider=None
                          ) -> Tuple[MetricsHTTPServer, threading.Thread]:
     """serve_metrics + a daemon thread running it; returns (server,
     thread).  Callers ``server.shutdown()`` when the run ends (or just
     exit — daemon threads don't pin the process)."""
     srv = serve_metrics(port, registry, flight=flight, host=host,
-                        labels=labels)
+                        labels=labels, jobs_provider=jobs_provider)
     t = threading.Thread(target=srv.serve_forever,
                          name="metrics-http", daemon=True)
     t.start()

@@ -251,6 +251,15 @@ class FlightRecorder:
         self._armed_context = None
         self._metrics = None
 
+    def context(self) -> dict:
+        """The armed run's context snapshot (engine/pipeline plus any
+        ``run_context_extra`` tags — job id / tenant under the serving
+        layer); {} when no run is live.  The server's per-job watch
+        reads this to attribute the ring's progress records to the job
+        that owns the device right now."""
+        with self._lock:
+            return dict(self._armed_context or {})
+
     @property
     def armed(self) -> bool:
         """A run is in flight.  Liveness, NOT dump-path-configured: a

@@ -77,11 +77,15 @@ def make_entry(kind: str, *, label: Optional[str] = None,
                fused_stages: Optional[dict] = None,
                report_summary: Optional[dict] = None,
                bench: Optional[dict] = None,
-               ts: Optional[float] = None) -> dict:
-    """One ledger line.  ``kind`` is ``check`` / ``bench`` / whatever a
-    legacy import labels; unknown fields stay None rather than absent so
-    every line has the same shape."""
-    return {
+               ts: Optional[float] = None,
+               extra: Optional[dict] = None) -> dict:
+    """One ledger line.  ``kind`` is ``check`` / ``bench`` / ``server``
+    (the checker service's executed-job entries, which carry ``job_id``
+    and ``tenant`` via ``extra``) / whatever a legacy import labels;
+    unknown fields stay None rather than absent so every line has the
+    same shape.  ``extra`` keys are merged last (they may not shadow
+    the schema: a colliding key raises)."""
+    out = {
         "v": ENTRY_VERSION,
         "ts": round(time.time() if ts is None else ts, 3),
         "kind": kind,
@@ -105,10 +109,16 @@ def make_entry(kind: str, *, label: Optional[str] = None,
         "report": dict(report_summary or {}) or None,
         "bench": bench,
     }
+    for k, v in (extra or {}).items():
+        if k in out:
+            raise ValueError(f"extra key {k!r} shadows a ledger field")
+        out[k] = v
+    return out
 
 
 def entry_from_result(kind: str, res, *, cfg_text=None, dims=None,
-                      host_fingerprint=None, label=None) -> dict:
+                      host_fingerprint=None, label=None,
+                      extra=None) -> dict:
     """Ledger entry from a finished ``EngineResult`` (the ``check
     --history`` writer).  Lazy import of report.summarize keeps this
     module's import graph flat."""
@@ -127,7 +137,8 @@ def entry_from_result(kind: str, res, *, cfg_text=None, dims=None,
         generated_per_sec=round(res.generated / wall, 1) if wall else None,
         pipeline=res.pipeline or None,
         fused_stages=res.fused_stages,
-        report_summary=summarize(getattr(res, "report", None)))
+        report_summary=summarize(getattr(res, "report", None)),
+        extra=extra)
 
 
 def entry_from_bench(doc: dict, *, label=None, kind="bench",
@@ -152,13 +163,18 @@ def entry_from_bench(doc: dict, *, label=None, kind="bench",
         bench=doc)
 
 
-def append_entry(path: str, entry: dict) -> None:
+def append_entry(path: str, entry: dict, default=None) -> None:
     """Append one JSONL line (O_APPEND single write — concurrent
-    appenders on a local filesystem interleave at line granularity)."""
+    appenders on a local filesystem interleave at line granularity).
+    ONE definition of the append idiom: the serving job journal
+    (serving/jobs.py) writes through here too (with ``default=str``
+    for its richer records), so a future durability change — fsync,
+    line-length guard — lands in every append-only log at once."""
     d = os.path.dirname(os.path.abspath(path))
     os.makedirs(d, exist_ok=True)
     with open(path, "a", encoding="utf-8") as f:
-        f.write(json.dumps(entry, sort_keys=True) + "\n")
+        f.write(json.dumps(entry, sort_keys=True, default=default)
+                + "\n")
 
 
 def read_history(path: str) -> List[dict]:

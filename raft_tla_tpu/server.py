@@ -53,12 +53,59 @@ response per line.  Requests:
        Run attach (obs/flight.py): snapshots come from the in-memory
        flight ring, not the event file — a check with no --events-out
        is still watchable.  Never takes the device lock.
+       With "job": "<job-id>" the stream scopes to ONE job (serving/):
+       snapshots carry the job summary plus ring progress while that
+       job owns the device, and the stream stays open for as long as
+       the job is alive — a watcher on a queued or compiling job is
+       never reaped as idle.  The done line carries the terminal job.
+
+Async jobs (serving/ — the multi-tenant job layer; see README
+"Serving & jobs" for full schemas):
+
+    {"op": "submit", "tenant": "acme", "job": {<check/simulate
+     request>}, "cache": false, "slo_seconds": null}
+        -> {"ok": true, "job": {id, state: "queued", ...}}
+       Bounded admission + per-tenant fair scheduling; the job runs on
+       the single executor thread under the same device lock as the
+       blocking ops.  Queue-full rejects answer {"ok": false} (and
+       count server/rejected/queue_full).  "cache": true completes a
+       repeat submit from the fingerprint-keyed result cache (refused
+       for max_seconds-budgeted requests — a truncated run is not
+       reusable).
+    {"op": "status", "job_id": ID}   -> {"ok": true, "job": {...}}
+    {"op": "result", "job_id": ID}   -> {"ok": true, "state": ...,
+                                         "result": {<check response>}}
+    {"op": "cancel", "job_id": ID}   -> {"ok": true, "job": {...}}
+       queued/admitted only — a running single-device job is not
+       preemptible; a cancelled job never ran and never will.
+    {"op": "jobs", "tenant": null, "state": null}
+        -> {"ok": true, "jobs": [...], "queue_depth": N, "running": N,
+            "by_state": {...}, "queue_capacity": N}
+
+    Every check job gets a scoped JSONL event log + postmortem dir
+    under --job-dir/<job-id>/ and job/tenant tags on the flight ring's
+    run_context (simulate jobs have neither — the simulator has no run
+    event log); every job gets per-tenant counters and queue-wait/SLO
+    histograms in the registry (the "stats"/"metrics" ops and the
+    --metrics-port HTTP endpoint expose them), and — with --history —
+    a kind=server run-history ledger entry.  The journal in --job-dir
+    makes the registry survive restarts: queued jobs resume, the job a
+    crash caught running is re-run once then failed with a postmortem
+    pointer.
 
 Errors: {"ok": false, "error": "<message>"}.  check/simulate are served
 one at a time (a checking run owns the device); concurrent connections
-queue.  ping/stats/metrics/watch never queue behind them.
+queue.  ping/stats/metrics/watch and the job ops never queue behind
+them (submit returns as soon as the job is journaled).
 
 Run:  python -m raft_tla_tpu.server [--port 8610] [--platform cpu]
+          [--job-dir DIR] [--job-queue N] [--history LEDGER]
+          [--metrics-port PORT]
+
+--metrics-port serves GET /metrics (Prometheus text exposition of the
+same registry as "stats"), /flight (the flight ring), and /jobs (the
+job registry) over HTTP from THIS process — the long-lived server is
+the natural scrape target, no engine-side listener required.
 
 Trust model: the service is UNAUTHENTICATED and the "cfg" op accepts an
 arbitrary filesystem path, whose parse errors can echo file contents —
@@ -91,6 +138,11 @@ _CACHE_CAP = 8
 from collections import OrderedDict  # noqa: E402
 _ENGINES: "OrderedDict" = OrderedDict()   # (cfg identity, opts) -> engine
 _SIMS: "OrderedDict" = OrderedDict()      # ditto for simulators
+# NOTE the run-history ledger path (--history) is deliberately NOT a
+# module global: several servers can live in one process (tests do),
+# and a global would split-brain their ledgers.  It rides per-request
+# telemetry (handle_request reads it off the server's JobManager, the
+# single source of truth the manager's own restart bookkeeping uses).
 
 
 def _cache_put(cache: "OrderedDict", key, value, name: str):
@@ -114,16 +166,18 @@ def _cache_get(cache: "OrderedDict", key, name: str):
 
 
 def _load_setup(req):
-    """Returns (setup, identity).  Identity is a hash of the cfg CONTENT
-    (not the path): editing a .cfg between requests must never serve the
-    previous model's engine."""
+    """Returns (setup, identity, cfg text).  Identity is a hash of the
+    cfg CONTENT (not the path): editing a .cfg between requests must
+    never serve the previous model's engine.  The text rides along for
+    the history ledger's cfg fingerprint."""
     import hashlib
     from .utils.cfg import load_config
     if req.get("cfg"):
         path = req["cfg"]
         with open(path, "rb") as f:
-            ident = hashlib.sha256(f.read()).hexdigest()
-        return load_config(path), ident
+            raw = f.read()
+        ident = hashlib.sha256(raw).hexdigest()
+        return load_config(path), ident, raw.decode(errors="replace")
     if req.get("cfg_text"):
         text = req["cfg_text"]
         ident = hashlib.sha256(text.encode()).hexdigest()
@@ -131,10 +185,23 @@ def _load_setup(req):
         try:
             f.write(text)
             f.close()
-            return load_config(f.name), ident
+            return load_config(f.name), ident, text
         finally:
             os.unlink(f.name)
     raise ValueError("need 'cfg' (path) or 'cfg_text'")
+
+
+def _cfg_label(req: dict) -> str:
+    """Ledger/job label for one request: the cfg basename, or a short
+    content fingerprint for path-less cfg_text submissions."""
+    if req.get("cfg"):
+        return os.path.basename(str(req["cfg"]))
+    if req.get("cfg_text"):
+        import hashlib
+        return ("cfg_text:"
+                + hashlib.sha256(req["cfg_text"].encode())
+                .hexdigest()[:10])
+    return "?"
 
 
 def _violation_json(engine, violation, dims):
@@ -154,7 +221,13 @@ def _violation_json(engine, violation, dims):
     return out
 
 
-def _do_check(req):
+def _do_check(req, telemetry=None):
+    """Run one check request.  ``telemetry`` (the job executor's
+    per-job scoping) carries ``events_out`` / ``postmortem_dir`` /
+    ``run_context`` overrides; they are applied to the (possibly warm,
+    cached) engine's host-side config on EVERY request — a direct
+    check after a job must reset them back to the request's own
+    values, never inherit the job's scoped paths."""
     from .engine.bfs import EngineConfig
     from .engine.check import initial_states, make_engine
 
@@ -163,7 +236,7 @@ def _do_check(req):
     import dataclasses
     from .engine.check import engine_config_from_backend
 
-    setup, ident = _load_setup(req)
+    setup, ident, cfg_text = _load_setup(req)
     record_trace = bool(req.get("trace", False))
     # Precedence everywhere (utils/cfg.py): request field > cfg "\* TPU:"
     # backend directive > built-in default — the backend-seeded config is
@@ -237,7 +310,38 @@ def _do_check(req):
     engine.config.max_diameter = (cfg.max_diameter
                                   if cfg.max_diameter is not None
                                   else setup.max_diameter)
+    # Per-request telemetry scoping (see docstring): ALWAYS assigned,
+    # so a cached engine never leaks one job's event log / postmortem
+    # dir / run tags into the next request's run.
+    tel = telemetry or {}
+    engine.config.events_out = tel.get("events_out", cfg.events_out)
+    engine.config.postmortem_dir = tel.get("postmortem_dir",
+                                           cfg.postmortem_dir)
+    engine.config.run_context_extra = tel.get("run_context")
+    history_path = tel.get("history")
     res = engine.run(initial_states(setup, seed=int(req.get("seed", 0))))
+    if history_path:
+        # Served-traffic leg of the run-history ledger: every
+        # server-executed check lands a kind=server entry (host_key +
+        # job/tenant ids when a job ran it) so bench_history renders
+        # served runs alongside CLI/bench ones.  Bookkeeping only —
+        # a ledger write failure must not fail the check response.
+        try:
+            from .obs import history as history_mod
+            from .obs.flight import host_fingerprint
+            ctx = tel.get("run_context") or {}
+            history_mod.append_entry(
+                history_path,
+                history_mod.entry_from_result(
+                    "server", res, cfg_text=cfg_text, dims=setup.dims,
+                    host_fingerprint=host_fingerprint(),
+                    label=_cfg_label(req),
+                    extra={"job_id": ctx.get("job_id"),
+                           "tenant": ctx.get("tenant")}))
+        except Exception as e:
+            import sys as _sys
+            print(f"server history append failed: "
+                  f"{type(e).__name__}: {e}", file=_sys.stderr)
     out = {"ok": True, "distinct": res.distinct,
            "generated": res.generated, "diameter": res.diameter,
            "levels": list(res.levels), "stop_reason": res.stop_reason,
@@ -279,7 +383,7 @@ def _do_simulate(req):
     from .engine.simulate import Simulator
     from .engine.check import initial_states
 
-    setup, ident = _load_setup(req)
+    setup, ident, _cfg_text = _load_setup(req)
     batch = (int(req["batch"]) if req.get("batch") is not None
              else int(setup.backend.get("BATCH", 1024)))
     depth = int(req.get("depth", 100))
@@ -335,13 +439,115 @@ def _do_stats() -> dict:
             "sim_cache": {"size": len(_SIMS), "capacity": _CACHE_CAP}}
 
 
-def handle_request(req: dict) -> dict:
+def _execute_job(request: dict, job: dict,
+                 history: Optional[str] = None) -> dict:
+    """JobManager executor: the job's request through the SAME device
+    lock + handlers as the blocking ops (engine semantics untouched),
+    with per-job telemetry scoping — the job's own event log and
+    postmortem dir, job/tenant tags on the flight ring's run_context
+    record, and the owning server's history ledger."""
+    tel = {"events_out": job.get("events_out"),
+           "postmortem_dir": job.get("job_dir"),
+           "history": history,
+           "run_context": {"job_id": job["id"],
+                           "tenant": job["tenant"]}}
+    with _LOCK:
+        if request.get("op") == "simulate":
+            return _do_simulate(request)
+        return _do_check(request, telemetry=tel)
+
+
+def _cache_key_for(req: dict, inner: dict) -> Optional[str]:
+    """Result-cache key for a submit request (None = uncacheable /
+    caching not asked for).  Keyed by cfg CONTENT fingerprint (the
+    history ledger's fingerprint idiom — the cfg text determines the
+    model) + the canonicalized engine-shaping request fields.
+    Wall-clock-budgeted requests are refused: a max_seconds-truncated
+    result is not reusable.  Structural invariant: a cacheable job is
+    ALWAYS content-pinned (``_do_submit`` converts cfg paths to
+    cfg_text before calling here) — fingerprinting a path the job
+    would re-read later is the poisoned-cache TOCTOU, so a path-based
+    cacheable request is rejected rather than keyed."""
+    if not req.get("cache"):
+        return None
+    if inner.get("max_seconds") is not None:
+        raise ValueError("cache: true is not allowed with max_seconds "
+                         "(a wall-clock-truncated result is not "
+                         "reusable)")
+    import hashlib
+    from .obs.history import fingerprint_text
+    if inner.get("cfg_text"):
+        cfg_fp = fingerprint_text(inner["cfg_text"])
+    elif inner.get("cfg"):
+        raise ValueError("cacheable jobs must be content-pinned "
+                         "(cfg_text); _do_submit converts paths")
+    else:
+        raise ValueError("need 'cfg' (path) or 'cfg_text'")
+    shape = {k: v for k, v in sorted(inner.items())
+             if k not in ("cfg", "cfg_text")}
+    blob = json.dumps([inner.get("op", "check"), cfg_fp, shape],
+                      sort_keys=True, default=str)
+    return hashlib.sha256(blob.encode()).hexdigest()
+
+
+def _do_submit(req: dict, manager) -> dict:
+    inner = req.get("job")
+    if not isinstance(inner, dict) \
+            or inner.get("op") not in ("check", "simulate"):
+        raise ValueError("submit needs a 'job' object whose op is "
+                         "'check' or 'simulate'")
+    label = _cfg_label(inner)
+    if req.get("cache") and inner.get("cfg"):
+        # Pin the cfg CONTENT at submit time: the cache key is
+        # fingerprinted now, but the job runs later — a path-based job
+        # would re-read the file at execution, and an edit in between
+        # would store the NEW model's result under the OLD content's
+        # key (a poisoned cache hit).  Content-addressing the job
+        # closes the window.
+        with open(inner["cfg"], encoding="utf-8") as f:
+            inner = dict(inner, cfg_text=f.read())
+        inner.pop("cfg")
+    job = manager.submit(dict(inner), tenant=req.get("tenant"),
+                         label=label,
+                         cache_key=_cache_key_for(req, inner),
+                         slo_seconds=req.get("slo_seconds"))
+    return {"ok": True, "job": job}
+
+
+def _do_job_op(op: str, req: dict, manager) -> dict:
+    if op == "jobs":
+        limit = req.get("limit")
+        out = {"ok": True}
+        out.update(manager.jobs_doc(
+            tenant=req.get("tenant"), state=req.get("state"),
+            limit=int(limit) if limit is not None else None))
+        return out
+    job_id = req.get("job_id")
+    if not job_id:
+        raise ValueError(f"{op} needs 'job_id'")
+    if op == "status":
+        return {"ok": True, "job": manager.get(job_id)}
+    if op == "cancel":
+        return {"ok": True, "job": manager.cancel(job_id)}
+    # op == "result": state + result read under one manager lock (a
+    # retention eviction between two reads must not turn a fetched
+    # result into an 'unknown job' error).
+    doc = manager.result_doc(job_id)
+    return {"ok": True, "state": doc["state"], "result": doc["result"]}
+
+
+#: Ops that need the job manager (serving/) — split out so the metric
+#: label table and the dispatch below can never disagree.
+_JOB_OPS = ("submit", "status", "result", "cancel", "jobs")
+
+
+def handle_request(req: dict, manager=None) -> dict:
     op = req.get("op")
     # Metric names must not echo client-controlled strings: one counter +
     # histogram per distinct bogus op would grow the process-global
     # registry without bound in this long-lived service.
     op_label = op if op in ("ping", "check", "simulate", "stats",
-                            "metrics") else "unknown"
+                            "metrics") + _JOB_OPS else "unknown"
     _METRICS.counter(f"server/requests/{op_label}")
     ok = False
     with _METRICS.phase_timer(f"request/{op_label}"):
@@ -354,10 +560,24 @@ def handle_request(req: dict) -> dict:
                 resp = _do_stats()
             elif op == "metrics":
                 resp = _do_metrics()
+            elif op in _JOB_OPS:
+                # Job ops never take the device lock: submit journals
+                # and returns; the executor thread does the running.
+                if manager is None:
+                    resp = {"ok": False,
+                            "error": "no job manager (job ops need a "
+                                     "served CheckerServer)"}
+                elif op == "submit":
+                    resp = _do_submit(req, manager)
+                else:
+                    resp = _do_job_op(op, req, manager)
             elif op in ("check", "simulate"):
+                # Direct (blocking) ops log to the same per-server
+                # ledger as jobs — the manager holds the path.
+                hist = getattr(manager, "history_path", None)
                 with _LOCK:
-                    resp = (_do_check(req) if op == "check"
-                            else _do_simulate(req))
+                    resp = (_do_check(req, telemetry={"history": hist})
+                            if op == "check" else _do_simulate(req))
             else:
                 resp = {"ok": False, "error": f"unknown op {op!r}"}
             ok = bool(resp.get("ok"))
@@ -428,7 +648,8 @@ class _Handler(socketserver.StreamRequestHandler):
                     if not self._serve_watch(req):
                         return
                     continue
-                resp = handle_request(req)
+                resp = handle_request(req,
+                                      getattr(self.server, "jobs", None))
             if not self._try_respond(resp):
                 return
 
@@ -437,6 +658,7 @@ class _Handler(socketserver.StreamRequestHandler):
         watched run ends, ``count`` snapshots have been sent, or the
         client goes away.  Never touches the device lock — attach to a
         server mid-check and the snapshots flow while the check runs.
+        With ``job`` the stream scopes to one job (``_serve_job_watch``).
         Returns False when the client died (ends the handler)."""
         import time as _time
 
@@ -452,6 +674,10 @@ class _Handler(socketserver.StreamRequestHandler):
         # 0/negative = until run end — still bounded so an orphaned
         # watcher cannot pin its handler thread forever.
         limit = count if count > 0 else 3600
+        mgr = getattr(self.server, "jobs", None)
+        if req.get("job"):
+            return self._serve_job_watch(str(req["job"]), mgr,
+                                         interval, count, limit)
         attach_seq = RECORDER.note_attach(
             transport="server", peer=str(self.client_address[0]),
             interval=interval, count=count)
@@ -474,15 +700,22 @@ class _Handler(socketserver.StreamRequestHandler):
             ended = (run_end is not None
                      and run_end["seq"] > attach_seq)
             saw_run = saw_run or RECORDER.armed or ended
+            # A live job queue counts as a live run for idleness: a
+            # watcher attached while jobs are still queued (the engine
+            # not yet armed) must ride out the whole queue wait, not
+            # get reaped by the no-run grace below — the --idle-timeout
+            # interplay regression (ISSUE 13 satellite).
+            jobs_alive = mgr is not None and mgr.has_live_jobs()
             # Done when: the watched run ended after we attached; an
             # explicit count is exhausted; or (count 0) the run we saw
             # is gone / none ever started within the grace window — a
             # watcher launched alongside its run must ride out engine
             # construction + XLA compilation (tens of seconds on a cold
             # cache), so the no-run-yet grace is time-based.
-            idle = (count <= 0 and not RECORDER.armed
+            idle = (count <= 0 and not RECORDER.armed and not jobs_alive
                     and (saw_run
-                         or _time.monotonic() - t_attach > 120.0))
+                         or _time.monotonic() - t_attach
+                         > self.server.watch_grace_seconds))
             if sent >= limit or ended or idle:
                 # Re-read: the run can end (emit run_end, then disarm)
                 # between the loop-top read and the idle computation —
@@ -495,6 +728,85 @@ class _Handler(socketserver.StreamRequestHandler):
                 return self._try_respond(
                     {"ok": True, "done": True, "snapshots": sent,
                      "run_end": end})
+            _time.sleep(interval)
+
+    def _serve_job_watch(self, job_id: str, mgr, interval: float,
+                         count: int, limit: int) -> bool:
+        """Per-job run attach: one snapshot per interval carrying the
+        job's registry summary, plus the flight ring's progress records
+        while THIS job owns the device (the manager's running id is
+        the authority; the ring's run_context carries the same job_id
+        tag).  Liveness is the JOB's, not the engine's: a queued or
+        compiling job keeps its watcher — the stream closes on the
+        job's terminal state, an explicit ``count``, or a ~24 h safety
+        bound; a bound hit on a still-live job closes with
+        ``truncated: true`` (re-attach to keep watching), never with a
+        false claim that the job ended."""
+        import time as _time
+
+        from .obs.flight import RECORDER
+        if count <= 0:
+            # The generic watch's 3600-snapshot cap would reap a
+            # watcher of a deeply queued job in minutes at small
+            # intervals; the job stream's orphan bound is a day.
+            limit = max(3600, int(86400.0 / interval))
+        if mgr is None:
+            return self._try_respond(
+                {"ok": False, "error": "no job manager"})
+        try:
+            job = mgr.get(job_id)
+        except KeyError as e:
+            return self._try_respond({"ok": False, "error": str(e)})
+        RECORDER.note_attach(
+            transport="server", peer=str(self.client_address[0]),
+            interval=interval, count=count, job_id=job_id)
+        sent = 0
+        while True:
+            try:
+                job = mgr.get(job_id)
+            except KeyError:
+                # Terminal-retention eviction raced the watch loop:
+                # the job went terminal and was pruned between polls.
+                # Close with a done line carrying the last summary we
+                # saw — never a dead socket with no terminal record.
+                return self._try_respond(
+                    {"ok": True, "done": True, "snapshots": sent,
+                     "job": job, "evicted": True})
+            running = mgr.running_job_id() == job_id
+            snapshot = {"seq": RECORDER.seq(), "armed": RECORDER.armed,
+                        "job": job, "running": running}
+            runrec = RECORDER.last_record("run_context")
+            if running and runrec is not None \
+                    and runrec.get("job_id") == job_id \
+                    and RECORDER.context().get("job_id") == job_id:
+                # Ring records are attributed to THIS job only once the
+                # armed run_context carries its tag, and only records
+                # NEWER than that context (seq-ordered) — a stale
+                # progress line from the previous run must never render
+                # as this job's.
+                snapshot["run"] = runrec
+                for key, rec in (
+                        ("progress", RECORDER.last_record("progress")),
+                        ("level",
+                         RECORDER.last_event("level_complete")),
+                        ("coverage", RECORDER.last_event("coverage"))):
+                    if rec is not None and rec["seq"] > runrec["seq"]:
+                        snapshot[key] = rec
+            terminal = job["state"] in ("done", "failed", "cancelled")
+            if terminal:
+                return self._try_respond(
+                    {"ok": True, "done": True, "snapshots": sent,
+                     "job": job})
+            if not self._try_respond({"ok": True, "watch": snapshot}):
+                return False
+            sent += 1
+            if sent >= limit:
+                return self._try_respond(
+                    {"ok": True, "done": True, "snapshots": sent,
+                     "job": job,
+                     # Only an explicit count is a clean close; the
+                     # safety bound on a live job is a truncation.
+                     "truncated": count <= 0})
             _time.sleep(interval)
 
     def _try_respond(self, resp: dict) -> bool:
@@ -515,18 +827,93 @@ class CheckerServer(socketserver.ThreadingTCPServer):
     # Hardening knobs (see _Handler): overridable per instance/CLI.
     max_request_bytes = 10 << 20       # a sane cfg_text is far smaller
     idle_timeout_seconds = 300.0
+    # How long a count-0 watch with NO live run and NO live jobs waits
+    # before concluding there is nothing to watch (see _serve_watch).
+    # Class-level so the idle-vs-watch regression tests can shrink it.
+    watch_grace_seconds = 120.0
+    # Serving layer (serve() wires these): the JobManager behind the
+    # submit/status/result/cancel/jobs ops + per-job watch, and the
+    # optional HTTP exposition listener (--metrics-port).
+    jobs = None
+    metrics_http = None
+
+    def server_close(self):
+        """Tear down the serving side too: the exposition listener's
+        socket and the job executor thread (its queued jobs stay
+        journaled for the next server on the same --job-dir).  The
+        close WAITS for the in-flight job to finish journaling its
+        terminal state — a same-process successor on the same job dir
+        would otherwise replay the journal's last word ('running'),
+        re-queue the job, and execute it twice while the old executor
+        is still finishing it (graceful drain, like the device lock)."""
+        if self.metrics_http is not None:
+            try:
+                self.metrics_http.shutdown()
+                self.metrics_http.server_close()
+            except Exception:
+                pass
+            self.metrics_http = None
+        if self.jobs is not None:
+            if not self.jobs.close(wait=True):
+                # The drain gave up (a check can outlast the join
+                # budget): the in-flight job is STILL RUNNING and will
+                # journal its terminal state when it finishes.  Say so
+                # loudly — a successor server started on this job dir
+                # before then would replay the 'running' tail and run
+                # that job a second time.
+                import sys
+                print(f"server_close: job executor still running "
+                      f"(job {self.jobs.running_job_id()}); do not "
+                      f"start another server on "
+                      f"{self.jobs.base_dir!r} until it finishes",
+                      file=sys.stderr)
+        super().server_close()
 
 
 def serve(host: str = "127.0.0.1", port: int = 8610,
           max_request_bytes: Optional[int] = None,
-          idle_timeout_seconds: Optional[float] = None) -> CheckerServer:
+          idle_timeout_seconds: Optional[float] = None,
+          job_dir: Optional[str] = None,
+          job_queue_capacity: Optional[int] = None,
+          history: Optional[str] = None,
+          metrics_port: Optional[int] = None) -> CheckerServer:
     """Create (and return) a listening server; caller decides threading.
-    Port 0 picks an ephemeral port (see ``server_address[1]``)."""
+    Port 0 picks an ephemeral port (see ``server_address[1]``).
+
+    ``job_dir`` is where the job journal + per-job artifact dirs live;
+    None uses a fresh per-process temp dir (jobs work, but the registry
+    does not survive a restart — pass a stable dir for that).
+    ``history`` appends a kind=server run-history ledger entry per
+    server-executed check (scoped to THIS server — several servers in
+    one process keep separate ledgers).  ``metrics_port`` serves GET
+    /metrics + /flight + /jobs over HTTP from this process (0 =
+    ephemeral port, see ``metrics_http.server_address``)."""
     srv = CheckerServer((host, port), _Handler)
     if max_request_bytes is not None:
         srv.max_request_bytes = max_request_bytes
     if idle_timeout_seconds is not None:
         srv.idle_timeout_seconds = idle_timeout_seconds
+    from .serving import JobManager
+    if job_dir is None:
+        job_dir = tempfile.mkdtemp(prefix="raft-jobs-")
+
+    def _executor(request, job):
+        return _execute_job(request, job, history=history)
+
+    srv.jobs = JobManager(
+        job_dir, executor=_executor, metrics=_METRICS,
+        history_path=history,
+        **({"queue_capacity": int(job_queue_capacity)}
+           if job_queue_capacity is not None else {}))
+    if metrics_port is not None:
+        from .obs.expose import start_metrics_server
+        from .obs.flight import RECORDER
+        srv.metrics_http, _ = start_metrics_server(
+            int(metrics_port), _METRICS, flight=RECORDER, host=host,
+            # Newest 1000 rows per GET: a scraper polling /jobs must
+            # not serialize the whole 10k-job retention under the
+            # manager lock every few seconds (counts stay global).
+            jobs_provider=lambda: srv.jobs.jobs_doc(limit=1000))
     return srv
 
 
@@ -544,19 +931,54 @@ def main(argv=None):
                    help="drop connections idle longer than this many "
                         "seconds "
                         f"(default {CheckerServer.idle_timeout_seconds})")
+    p.add_argument("--job-dir", default=None, metavar="DIR",
+                   help="job journal + per-job artifact dirs (serving/"
+                        "): pass a stable directory so the job "
+                        "registry survives restarts — queued jobs "
+                        "resume, the job a crash caught running is "
+                        "re-run once then failed with a postmortem "
+                        "pointer.  Default: a fresh temp dir (jobs "
+                        "work, no cross-restart durability)")
+    p.add_argument("--job-queue", type=int, default=None, metavar="N",
+                   help="admission queue capacity (queued jobs beyond "
+                        "this are rejected with server/rejected/"
+                        "queue_full; default 64)")
+    p.add_argument("--history", default=None, metavar="FILE",
+                   help="append a kind=server run-history ledger entry "
+                        "(obs/history.py, with host_key + job/tenant "
+                        "ids) per server-executed check, so "
+                        "scripts/bench_history.py renders served "
+                        "traffic alongside CLI runs")
+    p.add_argument("--metrics-port", type=int, default=None,
+                   metavar="PORT",
+                   help="serve GET /metrics (Prometheus text "
+                        "exposition), /flight (flight-recorder ring), "
+                        "and /jobs (job registry) over HTTP from this "
+                        "process — the natural scrape target for the "
+                        "long-lived service")
     args = p.parse_args(argv)
     if args.platform == "cpu":
         from .utils.platform import force_cpu
         force_cpu()
     srv = serve(args.host, args.port,
                 max_request_bytes=args.max_request_bytes,
-                idle_timeout_seconds=args.idle_timeout)
+                idle_timeout_seconds=args.idle_timeout,
+                job_dir=args.job_dir,
+                job_queue_capacity=args.job_queue,
+                history=args.history,
+                metrics_port=args.metrics_port)
     print(f"raft_tla_tpu checker service on "
           f"{srv.server_address[0]}:{srv.server_address[1]}")
+    if srv.metrics_http is not None:
+        print(f"metrics: http://{srv.metrics_http.server_address[0]}:"
+              f"{srv.metrics_http.server_address[1]}/metrics "
+              f"(+ /flight /jobs)")
     try:
         srv.serve_forever()
     except KeyboardInterrupt:
         pass
+    finally:
+        srv.server_close()
 
 
 if __name__ == "__main__":

@@ -1,0 +1,32 @@
+"""Check-as-a-service job layer (ROADMAP item 3, first slice).
+
+The checker service (server.py) historically ran one blocking check at
+a time under the device lock with no job identity: a client that
+disconnected lost its run, a slow model starved everyone behind it
+invisibly, and nothing attributed device time to tenants.  This package
+is the serving spine that fixes the *observability* half first — you
+cannot schedule what you cannot name:
+
+- :mod:`.jobs` — job records (states ``queued -> admitted -> running ->
+  done|failed|cancelled``) and the append-only JSONL **job journal**
+  that makes the registry survive a server restart;
+- :mod:`.manager` — :class:`~.manager.JobManager`: bounded admission,
+  per-tenant round-robin fair scheduling, a single executor thread
+  (engine semantics untouched — one run still owns the device), journal
+  replay with re-run/fail-with-postmortem semantics for the job a crash
+  caught running, a fingerprint-keyed result cache, and per-tenant
+  counters + queue-wait/turnaround/SLO histograms + by-state gauges in
+  the shared MetricsRegistry.
+
+server.py exposes it as the ``submit`` / ``status`` / ``result`` /
+``cancel`` / ``jobs`` ops, per-job ``watch`` attach, and the
+server-native HTTP ``/metrics`` + ``/jobs`` endpoints; the CLI client
+side is ``python -m raft_tla_tpu submit|jobs|watch``.  README "Serving
+& jobs" documents the op schemas and metric names.
+
+Jax-free at import, like ``obs/``.
+"""
+
+from .jobs import (JOB_STATES, LIVE_STATES, QueueFullError,  # noqa: F401
+                   TERMINAL_STATES)
+from .manager import JobManager                              # noqa: F401

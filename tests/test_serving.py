@@ -1,0 +1,895 @@
+"""Serving-layer tests (ISSUE 13): the async job manager + server ops.
+
+Two halves:
+
+- **Manager unit tests** against a stub executor (no engine, no device):
+  lifecycle + journal, least-recently-served fairness, bounded
+  admission, cancel races and the cancelled-never-ran invariant, the
+  result cache, and journal replay (queued jobs resume; the job a crash
+  caught running is re-run once, then failed with a postmortem
+  pointer).
+- **Server integration tests** through the real checker service + real
+  engine on the pinned MCraft_bounded profile: concurrent multi-tenant
+  submits bit-identical to sequential direct checks, per-job scoped
+  event logs, per-tenant metrics + SLO histograms agreeing between the
+  stats op and the server-native HTTP /metrics endpoint, per-job watch
+  streams, the idle-timeout-vs-watch regression, and restart replay.
+"""
+
+import json
+import os
+import socket
+import threading
+import time
+import urllib.request
+
+import pytest
+
+from raft_tla_tpu import server as srv_mod
+from raft_tla_tpu.serving import (JobManager, QueueFullError,
+                                  TERMINAL_STATES)
+from raft_tla_tpu.serving import jobs as jobs_mod
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+CFG = os.path.join(REPO, "configs/MCraft_bounded.cfg")
+
+
+# ---------------------------------------------------------------------------
+# Manager unit tests (stub executor — no engine, no device lock).
+
+def wait_terminal(m, timeout=30.0):
+    t0 = time.monotonic()
+    while time.monotonic() - t0 < timeout:
+        doc = m.jobs_doc()
+        if all(j["state"] in TERMINAL_STATES for j in doc["jobs"]):
+            return doc
+        time.sleep(0.02)
+    raise AssertionError(f"jobs never settled: {m.jobs_doc()}")
+
+
+def test_lifecycle_metrics_and_journal(tmp_path):
+    ran = []
+
+    def ex(req, job):
+        ran.append(job["id"])
+        return {"ok": True, "distinct": req["n"]}
+
+    m = JobManager(str(tmp_path), executor=ex, slo_seconds=60.0)
+    try:
+        s = m.submit({"op": "check", "n": 7}, tenant="acme",
+                     label="lbl")
+        assert s["state"] == "queued" and s["tenant"] == "acme"
+        doc = wait_terminal(m)
+        assert doc["by_state"]["done"] == 1
+        job = m.get(s["id"])
+        assert job["state"] == "done" and job["has_result"]
+        # Timestamps + derived durations are populated and ordered.
+        assert job["created_ts"] <= job["admitted_ts"] \
+            <= job["started_ts"] <= job["finished_ts"]
+        assert job["queue_wait_seconds"] >= 0
+        assert job["turnaround_seconds"] >= job["run_seconds"]
+        assert m.result(s["id"]) == {"ok": True, "distinct": 7}
+        snap = m.metrics.snapshot()
+        assert snap["counters"]["jobs/submitted/acme"] == 1
+        assert snap["counters"]["jobs/done/acme"] == 1
+        assert snap["counters"]["jobs/slo_ok/acme"] == 1
+        for h in ("jobs/queue_wait_seconds", "jobs/run_seconds",
+                  "jobs/turnaround_seconds",
+                  "jobs/turnaround_seconds/acme"):
+            assert snap["histograms"][h]["count"] == 1, h
+        assert snap["gauges"]["jobs/state/done"] == 1
+        assert snap["gauges"]["jobs/queue_depth"] == 0
+        # The journal replays to the same terminal picture, cleanly.
+        jobs, results, problems = jobs_mod.replay(m.journal_path)
+        assert jobs[s["id"]]["state"] == "done"
+        assert results[s["id"]]["distinct"] == 7
+        assert problems == []
+    finally:
+        m.close()
+
+
+def test_fair_scheduling_least_recently_served(tmp_path):
+    order = []
+    gate = threading.Event()
+
+    def ex(req, job):
+        gate.wait(10)
+        order.append((job["tenant"], req["n"]))
+        return {"ok": True}
+
+    # start=False: enqueue everything first, then run the loop, so the
+    # pick order is purely the scheduler's.
+    m = JobManager(str(tmp_path), executor=ex, start=False)
+    for n in (1, 2, 3):
+        m.submit({"op": "check", "n": n}, tenant="a")
+    m.submit({"op": "check", "n": 10}, tenant="b")
+    m.submit({"op": "check", "n": 11}, tenant="b")
+    m.submit({"op": "check", "n": 20}, tenant="c")
+    gate.set()
+    m._thread = threading.Thread(target=m._loop, daemon=True)
+    m._thread.start()
+    try:
+        wait_terminal(m)
+        # Round-robin across tenants (a queue-flooding tenant cannot
+        # starve b/c), FIFO within a tenant, ties by join order.
+        assert order == [("a", 1), ("b", 10), ("c", 20),
+                         ("a", 2), ("b", 11), ("a", 3)], order
+    finally:
+        m.close()
+
+
+def test_queue_overflow_rejects_cleanly(tmp_path):
+    def ex(req, job):
+        return {"ok": True}
+
+    m = JobManager(str(tmp_path), executor=ex, queue_capacity=2,
+                   start=False)      # nothing drains: depth is exact
+    try:
+        m.submit({"op": "check"}, tenant="t")
+        m.submit({"op": "check"}, tenant="t")
+        with pytest.raises(QueueFullError, match="queue full"):
+            m.submit({"op": "check"}, tenant="t")
+        snap = m.metrics.snapshot()
+        assert snap["counters"]["server/rejected/queue_full"] == 1
+        assert snap["counters"]["jobs/rejected/t"] == 1
+        # The reject did not corrupt the registry: still 2 queued.
+        assert m.jobs_doc()["queue_depth"] == 2
+    finally:
+        m.close(wait=False)
+
+
+def test_cancel_invariants_and_submit_cancel_races(tmp_path):
+    executed = []
+    gate = threading.Event()
+
+    def ex(req, job):
+        gate.wait(10)
+        executed.append(job["id"])
+        return {"ok": True}
+
+    m = JobManager(str(tmp_path), executor=ex)
+    try:
+        first = m.submit({"op": "check"}, tenant="t")
+        victims = [m.submit({"op": "check"}, tenant="t")
+                   for _ in range(6)]
+        # Concurrent cancels racing each other and the scheduler: each
+        # job is cancelled by exactly one winner; double-cancel raises.
+        errs = []
+
+        def do_cancel(jid):
+            try:
+                m.cancel(jid)
+            except (ValueError, KeyError) as e:
+                errs.append(str(e))
+
+        ts = [threading.Thread(target=do_cancel, args=(v["id"],))
+              for v in victims for _ in (0, 1)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        gate.set()
+        doc = wait_terminal(m)
+        assert doc["by_state"]["cancelled"] == 6
+        assert doc["by_state"]["done"] == 1
+        # Exactly one cancel per job won; the other raced and raised.
+        assert len(errs) == 6 and all("already cancelled" in e
+                                      for e in errs)
+        # THE invariant: a cancelled job never reached the executor,
+        # has no result, and its terminal state stuck.
+        assert executed == [first["id"]]
+        for v in victims:
+            job = m.get(v["id"])
+            assert job["state"] == "cancelled"
+            assert job["started_ts"] is None
+            assert not job["has_result"]
+            with pytest.raises(ValueError, match="no result"):
+                m.result(v["id"])
+        assert m.metrics.snapshot()["counters"]["jobs/cancelled/t"] == 6
+    finally:
+        m.close(wait=False)
+
+
+def test_cancel_running_refused(tmp_path):
+    gate = threading.Event()
+    release = threading.Event()
+
+    def ex(req, job):
+        gate.set()
+        release.wait(10)
+        return {"ok": True}
+
+    m = JobManager(str(tmp_path), executor=ex)
+    try:
+        s = m.submit({"op": "check"}, tenant="t")
+        assert gate.wait(10)
+        assert m.running_job_id() == s["id"]
+        assert m.has_live_jobs()
+        with pytest.raises(ValueError, match="not preemptible"):
+            m.cancel(s["id"])
+        release.set()
+        wait_terminal(m)
+        assert m.get(s["id"])["state"] == "done"
+        with pytest.raises(ValueError, match="already done"):
+            m.cancel(s["id"])
+    finally:
+        release.set()
+        m.close()
+
+
+def test_result_cache_hit_and_miss(tmp_path):
+    calls = []
+
+    def ex(req, job):
+        calls.append(job["id"])
+        return {"ok": True, "distinct": 42}
+
+    m = JobManager(str(tmp_path), executor=ex)
+    try:
+        a = m.submit({"op": "check"}, tenant="t", cache_key="K")
+        wait_terminal(m)
+        b = m.submit({"op": "check"}, tenant="t", cache_key="K")
+        c = m.submit({"op": "check"}, tenant="t", cache_key="K2")
+        wait_terminal(m)
+        assert len(calls) == 2          # a (miss) + c (miss); b hit
+        jb = m.get(b["id"])
+        assert jb["state"] == "done" and jb["cached"] is True
+        assert m.get(a["id"])["cached"] is False
+        assert m.result(b["id"]) == m.result(a["id"])
+        snap = m.metrics.snapshot()["counters"]
+        assert snap["jobs/result_cache/hits"] == 1
+        assert snap["jobs/result_cache/misses"] == 2
+        # Replay seeds the cache from done jobs: a restarted manager
+        # still hits.
+        m.close()
+        m2 = JobManager(str(tmp_path), executor=ex)
+        d = m2.submit({"op": "check"}, tenant="t", cache_key="K")
+        wait_terminal(m2)
+        assert m2.get(d["id"])["cached"] is True
+        assert len(calls) == 2
+        m2.close()
+    finally:
+        m.close(wait=False)
+
+
+def test_failed_job_records_error(tmp_path):
+    def ex(req, job):
+        raise RuntimeError("engine exploded")
+
+    m = JobManager(str(tmp_path), executor=ex)
+    try:
+        s = m.submit({"op": "check"}, tenant="t")
+        wait_terminal(m)
+        job = m.get(s["id"])
+        assert job["state"] == "failed"
+        assert "engine exploded" in job["error"]
+        assert m.metrics.snapshot()["counters"]["jobs/failed/t"] == 1
+        with pytest.raises(ValueError, match="engine exploded"):
+            m.result(s["id"])
+    finally:
+        m.close()
+
+
+def test_replay_resumes_queued_jobs(tmp_path):
+    def ex(req, job):
+        return {"ok": True, "n": req["n"]}
+
+    m1 = JobManager(str(tmp_path), executor=ex, start=False)
+    a = m1.submit({"op": "check", "n": 1}, tenant="t")
+    b = m1.submit({"op": "check", "n": 2}, tenant="t")
+    m1.close(wait=False)     # "restart": nothing ever ran
+    m2 = JobManager(str(tmp_path), executor=ex)
+    try:
+        wait_terminal(m2)
+        for s, n in ((a, 1), (b, 2)):
+            job = m2.get(s["id"])
+            assert job["state"] == "done"
+            assert job["note"] == "resumed_after_restart"
+            assert m2.result(s["id"])["n"] == n
+    finally:
+        m2.close()
+
+
+def _craft_running_journal(tmp_path, restarts, with_postmortem):
+    """A journal whose last word on job jX is ``running`` — the shape a
+    crash leaves behind."""
+    base = str(tmp_path)
+    journal = os.path.join(base, "jobs.jsonl")
+    job = jobs_mod.new_job("jX-cafe42", "acme", {"op": "check"})
+    job["job_dir"] = os.path.join(base, job["id"])
+    job["events_out"] = os.path.join(job["job_dir"], "events.jsonl")
+    jobs_mod.append_record(journal, jobs_mod.submit_record(job))
+    job["state"] = "running"
+    job["restarts"] = restarts
+    jobs_mod.append_record(
+        journal, jobs_mod.state_record(
+            job, patch={"restarts": restarts,
+                        "started_ts": round(time.time(), 6)}))
+    if with_postmortem:
+        os.makedirs(job["job_dir"], exist_ok=True)
+        with open(os.path.join(job["job_dir"], "postmortem.json"),
+                  "w") as f:
+            json.dump({"postmortem": True, "reason": "test"}, f)
+    return job["id"]
+
+
+def test_replay_reruns_job_caught_running_once(tmp_path):
+    ran = []
+
+    def ex(req, job):
+        ran.append(job["id"])
+        return {"ok": True}
+
+    jid = _craft_running_journal(tmp_path, restarts=0,
+                                 with_postmortem=False)
+    m = JobManager(str(tmp_path), executor=ex)
+    try:
+        wait_terminal(m)
+        job = m.get(jid)
+        assert job["state"] == "done" and ran == [jid]
+        assert job["restarts"] == 1
+        assert job["note"] == "requeued_after_restart"
+        assert m.metrics.snapshot()["counters"][
+            "jobs/requeued_after_restart"] == 1
+    finally:
+        m.close()
+
+
+def test_replay_fails_twice_lost_job_with_postmortem(tmp_path):
+    ran = []
+
+    def ex(req, job):
+        ran.append(job["id"])
+        return {"ok": True}
+
+    hist = str(tmp_path / "ledger.jsonl")
+    jid = _craft_running_journal(tmp_path, restarts=1,
+                                 with_postmortem=True)
+    m = JobManager(str(tmp_path), executor=ex, history_path=hist)
+    try:
+        job = m.get(jid)
+        assert job["state"] == "failed" and ran == []
+        assert "restart" in job["error"]
+        assert job["postmortem"] and job["postmortem"].endswith(
+            "postmortem.json")
+        assert os.path.exists(job["postmortem"])
+        # The loss is on the history ledger too (kind=server, job id).
+        from raft_tla_tpu.obs import history as history_mod
+        entries = history_mod.read_history(hist)
+        assert entries[-1]["kind"] == "server"
+        assert entries[-1]["verdict"] == "lost-after-restart"
+        assert entries[-1]["job_id"] == jid
+        assert entries[-1]["tenant"] == "acme"
+    finally:
+        m.close(wait=False)
+
+
+def test_terminal_retention_evicts_oldest(tmp_path):
+    def ex(req, job):
+        return {"ok": True, "n": req["n"]}
+
+    m = JobManager(str(tmp_path), executor=ex, max_terminal_jobs=2)
+    try:
+        subs = [m.submit({"op": "check", "n": n}, tenant="t")
+                for n in range(4)]
+        wait_terminal(m)
+        doc = m.jobs_doc()
+        assert doc["by_state"]["done"] == 2           # census pruned too
+        kept = {j["id"] for j in doc["jobs"]}
+        assert kept == {subs[2]["id"], subs[3]["id"]}  # oldest evicted
+        with pytest.raises(KeyError):
+            m.result(subs[0]["id"])
+        assert m.result(subs[3]["id"])["n"] == 3
+        assert m.metrics.snapshot()["counters"]["jobs/evicted"] == 2
+    finally:
+        m.close()
+
+
+def test_journal_failure_does_not_kill_executor(tmp_path):
+    """Review fix: a full disk (journal append OSError) must degrade to
+    a counted durability loss — the executor keeps draining the queue
+    and the in-memory registry stays consistent."""
+    def ex(req, job):
+        return {"ok": True}
+
+    m = JobManager(str(tmp_path), executor=ex)
+    try:
+        # Point the journal at a DIRECTORY: every append now raises
+        # IsADirectoryError (an OSError) inside submit + transitions.
+        broken = tmp_path / "broken.jsonl"
+        broken.mkdir()
+        m.journal_path = str(broken)
+        a = m.submit({"op": "check"}, tenant="t")
+        b = m.submit({"op": "check"}, tenant="t")
+        wait_terminal(m)
+        assert m.get(a["id"])["state"] == "done"
+        assert m.get(b["id"])["state"] == "done"
+        assert m.metrics.snapshot()["counters"]["jobs/journal_errors"] \
+            >= 2
+    finally:
+        m.close()
+
+
+def test_requeued_job_queue_wait_excludes_downtime(tmp_path):
+    """Review fix: a restart-requeued job's queue_wait must price THIS
+    server's queue (enqueued_ts base), not the pre-crash run + the
+    downtime (created_ts base) — turnaround still spans the whole
+    customer wait."""
+    def ex(req, job):
+        return {"ok": True}
+
+    jid = _craft_running_journal(tmp_path, restarts=0,
+                                 with_postmortem=False)
+    # Age the journal's created_ts far into the past.
+    journal = os.path.join(str(tmp_path), "jobs.jsonl")
+    lines = [json.loads(ln) for ln in open(journal)]
+    lines[0]["job"]["created_ts"] -= 600.0
+    lines[0]["job"]["enqueued_ts"] -= 600.0
+    with open(journal, "w") as f:
+        for rec in lines:
+            f.write(json.dumps(rec) + "\n")
+    m = JobManager(str(tmp_path), executor=ex)
+    try:
+        wait_terminal(m)
+        job = m.get(jid)
+        assert job["state"] == "done"
+        assert job["queue_wait_seconds"] < 30, job["queue_wait_seconds"]
+        assert job["turnaround_seconds"] > 590, job["turnaround_seconds"]
+    finally:
+        m.close()
+
+
+def test_degraded_journal_replays_tolerantly(tmp_path):
+    """Round-4 review fix: a journal degraded by best-effort writes (a
+    torn trailing line, an orphan state record whose submit line was
+    lost) must replay what it can and start — never permanently brick
+    every restart on this job dir."""
+    def ex(req, job):
+        return {"ok": True}
+
+    m1 = JobManager(str(tmp_path), executor=ex, start=False)
+    good = m1.submit({"op": "check"}, tenant="t")
+    m1.close(wait=False)
+    journal = os.path.join(str(tmp_path), "jobs.jsonl")
+    with open(journal, "a") as f:
+        # Orphan state record (its submit line was lost to a full
+        # disk) + a torn line from a crash mid-write.
+        f.write(json.dumps({"rec": "state", "id": "j-lost",
+                            "state": "running", "ts": 1.0}) + "\n")
+        f.write('{"rec": "state", "id": "j-torn", "sta')
+    jobs, _results, problems = jobs_mod.replay(journal)
+    assert good["id"] in jobs
+    assert len(problems) == 2, problems
+    m2 = JobManager(str(tmp_path), executor=ex)
+    try:
+        wait_terminal(m2)
+        assert m2.get(good["id"])["state"] == "done"
+        assert m2.metrics.snapshot()["counters"][
+            "jobs/journal_skipped"] == 2
+    finally:
+        m2.close()
+
+
+def test_tenant_label_collision_gets_suffix(tmp_path):
+    def ex(req, job):
+        return {"ok": True}
+
+    m = JobManager(str(tmp_path), executor=ex, start=False)
+    try:
+        m.submit({"op": "check"}, tenant="acme corp")
+        m.submit({"op": "check"}, tenant="acme_corp")
+        counters = m.metrics.snapshot()["counters"]
+        labels = [k.split("/")[-1] for k in counters
+                  if k.startswith("jobs/submitted/")]
+        # Both tenants submitted once, into DISTINCT series.
+        assert len(labels) == 2 and len(set(labels)) == 2, labels
+        assert all(counters[f"jobs/submitted/{lb}"] == 1
+                   for lb in labels)
+    finally:
+        m.close(wait=False)
+
+
+def test_tenant_metric_labels_bounded(tmp_path):
+    def ex(req, job):
+        return {"ok": True}
+
+    m = JobManager(str(tmp_path), executor=ex, tenant_cap=2,
+                   start=False)
+    try:
+        m.submit({"op": "check"}, tenant="t/1 weird\nname")
+        m.submit({"op": "check"}, tenant="t2")
+        m.submit({"op": "check"}, tenant="t3-overflows-the-cap")
+        counters = m.metrics.snapshot()["counters"]
+        assert counters["jobs/submitted/t_1_weird_name"] == 1
+        assert counters["jobs/submitted/t2"] == 1
+        # Past the cap, tenants fold into one bounded label.
+        assert counters["jobs/submitted/other"] == 1
+    finally:
+        m.close(wait=False)
+
+
+# ---------------------------------------------------------------------------
+# Server integration (real engine, pinned MCraft_bounded profile).
+
+@pytest.fixture(scope="module")
+def jobsrv(tmp_path_factory):
+    base = tmp_path_factory.mktemp("serving")
+    hist = str(base / "ledger.jsonl")
+    srv = srv_mod.serve(port=0, job_dir=str(base / "jobs"),
+                        history=hist, metrics_port=0)
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    yield srv, hist
+    srv.shutdown()
+    srv.server_close()
+
+
+def roundtrip(addr, req: dict) -> dict:
+    with socket.create_connection(addr, timeout=600) as s:
+        s.sendall((json.dumps(req) + "\n").encode())
+        buf = b""
+        while not buf.endswith(b"\n"):
+            chunk = s.recv(65536)
+            if not chunk:
+                break
+            buf += chunk
+    return json.loads(buf)
+
+
+BASE = {"op": "check", "cfg": CFG, "batch": 128,
+        "queue_capacity": 1 << 12, "seen_capacity": 1 << 15,
+        "check_deadlock": False}
+
+
+def _wait_jobs_settled(addr, ids, timeout=600.0):
+    t0 = time.monotonic()
+    while time.monotonic() - t0 < timeout:
+        doc = roundtrip(addr, {"op": "jobs"})
+        byid = {j["id"]: j for j in doc["jobs"]}
+        if all(byid[i]["state"] in TERMINAL_STATES for i in ids):
+            return doc
+        time.sleep(0.1)
+    raise AssertionError(f"jobs never settled: {doc}")
+
+
+def test_concurrent_multi_tenant_jobs_bitidentical(jobsrv):
+    """ISSUE 13 acceptance: N concurrent jobs from >= 2 tenants all
+    reach terminal states with results bit-identical to the same
+    checks run sequentially through the blocking check op, while the
+    jobs observably overlapped in queued/admitted states."""
+    srv, _hist = jobsrv
+    addr = srv.server_address
+    seq3 = roundtrip(addr, dict(BASE, max_diameter=3))
+    seq4 = roundtrip(addr, dict(BASE, max_diameter=4))
+    assert seq3["ok"] and seq3["distinct"] == 113
+    assert seq4["ok"] and seq4["distinct"] == 527
+    subs = []
+    for tenant, d in (("t1", 3), ("t2", 4), ("t1", 4)):
+        r = roundtrip(addr, {"op": "submit", "tenant": tenant,
+                             "job": dict(BASE, max_diameter=d)})
+        assert r["ok"], r
+        assert r["job"]["state"] == "queued"
+        subs.append((r["job"]["id"], seq3 if d == 3 else seq4))
+    # Overlap is observable: right after the submits, >= 2 jobs are
+    # live at once and >= 1 is still waiting in the queue.
+    doc = roundtrip(addr, {"op": "jobs"})
+    live = [j for j in doc["jobs"]
+            if j["state"] in ("queued", "admitted", "running")]
+    assert len(live) >= 2, doc
+    assert doc["queue_depth"] >= 1, doc
+    doc = _wait_jobs_settled(addr, [jid for jid, _ in subs])
+    assert doc["by_state"]["failed"] == 0
+    for jid, want in subs:
+        res = roundtrip(addr, {"op": "result", "job_id": jid})
+        assert res["ok"], res
+        got = res["result"]
+        assert (got["distinct"], got["generated"], got["levels"]) \
+            == (want["distinct"], want["generated"], want["levels"])
+
+
+def test_per_job_event_logs_and_job_metrics(jobsrv):
+    """Every executed job has a scoped JSONL event log that
+    validate_run_events accepts, and the queue-wait/turnaround/SLO
+    surfaces are populated in both the stats op and the server-native
+    Prometheus endpoint (which must agree)."""
+    from raft_tla_tpu.obs import parse_prometheus, validate_run_events
+    from raft_tla_tpu.obs.expose import counter_sample
+    srv, _hist = jobsrv
+    addr = srv.server_address
+    doc = roundtrip(addr, {"op": "jobs", "state": "done"})
+    assert doc["jobs"], "run test_concurrent_multi_tenant_jobs first"
+    for j in doc["jobs"]:
+        evs = validate_run_events(j["events_out"])
+        kinds = {e["event"] for e in evs}
+        assert {"run_start", "run_end"} <= kinds, (j["id"], kinds)
+        assert j["queue_wait_seconds"] is not None
+        assert j["turnaround_seconds"] >= (j["run_seconds"] or 0)
+    stats = roundtrip(addr, {"op": "stats"})
+    counters = stats["metrics"]["counters"]
+    hists = stats["metrics"]["histograms"]
+    assert counters["jobs/submitted/t1"] >= 2
+    assert counters["jobs/submitted/t2"] >= 1
+    assert counters["jobs/done/t1"] >= 2
+    assert hists["jobs/queue_wait_seconds"]["count"] >= 3
+    assert hists["jobs/turnaround_seconds"]["count"] >= 3
+    assert counters["jobs/slo_ok/t1"] + counters.get("jobs/slo_miss/t1",
+                                                     0) >= 2
+    # by-state gauges mirror the jobs op's registry view.
+    alldoc = roundtrip(addr, {"op": "jobs"})
+    assert stats["metrics"]["gauges"]["jobs/state/done"] \
+        == alldoc["by_state"]["done"]
+    # Server-native HTTP endpoint: same registry, same numbers.
+    hp = srv.metrics_http.server_address
+    body = urllib.request.urlopen(
+        f"http://{hp[0]}:{hp[1]}/metrics", timeout=60).read().decode()
+    samples = parse_prometheus(body)        # raises if invalid
+    assert "raft_jobs_queue_wait_seconds_bucket" in samples
+    assert counter_sample(samples, "jobs/submitted/t1") \
+        == counters["jobs/submitted/t1"]
+    jd = json.loads(urllib.request.urlopen(
+        f"http://{hp[0]}:{hp[1]}/jobs", timeout=60).read())
+    assert jd["ok"] and jd["by_state"]["done"] \
+        == alldoc["by_state"]["done"]
+    # /flight still serves (the watch console's poll target).
+    fd = json.loads(urllib.request.urlopen(
+        f"http://{hp[0]}:{hp[1]}/flight?last=4", timeout=60).read())
+    assert fd["ok"] and "records" in fd
+
+
+def test_server_history_ledger_served_traffic(jobsrv):
+    """Satellite: server-executed checks land kind=server ledger
+    entries (host_key + job/tenant ids) renderable by bench_history
+    alongside CLI runs."""
+    from raft_tla_tpu.obs import history as history_mod
+    srv, hist = jobsrv
+    entries = history_mod.read_history(hist)
+    server_entries = [e for e in entries if e["kind"] == "server"]
+    assert server_entries, "no served-traffic entries"
+    jobful = [e for e in server_entries if e.get("job_id")]
+    direct = [e for e in server_entries if e.get("job_id") is None]
+    assert jobful and direct            # jobs AND blocking checks
+    for e in server_entries:
+        assert e["host_key"], e         # same-host comparability key
+        assert e["verdict"] == "ok"
+        assert e["distinct"] in (113, 527)
+    assert {e["tenant"] for e in jobful} >= {"t1", "t2"}
+    # The trajectory table renders them (kind column = server).
+    table = history_mod.render_table(entries)
+    assert "server" in table
+
+
+def test_queue_overflow_op_rejects_cleanly():
+    """Satellite: a queue-overflow submit answers a clean
+    ``{"ok": false}`` line (the connection stays usable) and bumps the
+    ``server/rejected/queue_full`` + per-tenant counters."""
+    import tempfile
+    srv = srv_mod.serve(port=0, job_dir=tempfile.mkdtemp(),
+                        job_queue_capacity=1)
+    srv.jobs.close(wait=False)          # executor off: depth is exact
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    try:
+        addr = srv.server_address
+        before = srv_mod._METRICS.counter_value(
+            "server/rejected/queue_full")
+        r1 = roundtrip(addr, {"op": "submit", "tenant": "flood",
+                              "job": dict(BASE, max_diameter=2)})
+        assert r1["ok"], r1
+        r2 = roundtrip(addr, {"op": "submit", "tenant": "flood",
+                              "job": dict(BASE, max_diameter=2)})
+        assert r2["ok"] is False and "queue full" in r2["error"], r2
+        counters = roundtrip(addr, {"op": "stats"})["metrics"][
+            "counters"]
+        assert counters["server/rejected/queue_full"] == before + 1
+        assert counters["jobs/rejected/flood"] >= 1
+        # The queued job is intact and the registry consistent.
+        doc = roundtrip(addr, {"op": "jobs", "tenant": "flood"})
+        assert doc["queue_depth"] >= 1
+    finally:
+        srv.shutdown()
+        srv.server_close()
+
+
+def test_cancel_op_terminal_invariants(jobsrv):
+    """Cancel through the op: terminal-state invariants over the wire
+    against a saturated executor."""
+    srv, _hist = jobsrv
+    addr = srv.server_address
+    # Saturate: a wall-clock-budgeted job occupies the executor while
+    # we queue more behind it.
+    slow = dict(BASE, max_diameter=None, max_seconds=2.0)
+    r1 = roundtrip(addr, {"op": "submit", "tenant": "t1", "job": slow})
+    r2 = roundtrip(addr, {"op": "submit", "tenant": "t2",
+                          "job": dict(BASE, max_diameter=3)})
+    assert r1["ok"] and r2["ok"]
+    c = roundtrip(addr, {"op": "cancel", "job_id": r2["job"]["id"]})
+    if c["ok"]:          # r2 could already be running on a warm engine
+        assert c["job"]["state"] == "cancelled"
+        res = roundtrip(addr, {"op": "result",
+                               "job_id": r2["job"]["id"]})
+        assert not res["ok"] and "no result" in res["error"]
+        # A cancelled job's terminal state sticks.
+        again = roundtrip(addr, {"op": "cancel",
+                                 "job_id": r2["job"]["id"]})
+        assert not again["ok"] and "already cancelled" in again["error"]
+    bogus = roundtrip(addr, {"op": "cancel", "job_id": "nope"})
+    assert not bogus["ok"] and "unknown job" in bogus["error"]
+    _wait_jobs_settled(addr, [r1["job"]["id"], r2["job"]["id"]])
+
+
+def test_submit_cache_flag_and_rejects(jobsrv):
+    srv, _hist = jobsrv
+    addr = srv.server_address
+    req = {"op": "submit", "tenant": "t1", "cache": True,
+           "job": dict(BASE, max_diameter=2)}
+    r1 = roundtrip(addr, req)
+    assert r1["ok"], r1
+    _wait_jobs_settled(addr, [r1["job"]["id"]])
+    r2 = roundtrip(addr, req)
+    assert r2["ok"], r2
+    _wait_jobs_settled(addr, [r2["job"]["id"]])
+    j2 = roundtrip(addr, {"op": "status", "job_id": r2["job"]["id"]})
+    assert j2["job"]["cached"] is True
+    a = roundtrip(addr, {"op": "result", "job_id": r1["job"]["id"]})
+    b = roundtrip(addr, {"op": "result", "job_id": r2["job"]["id"]})
+    assert a["result"] == b["result"]
+    stats = roundtrip(addr, {"op": "stats"})
+    assert stats["metrics"]["counters"]["jobs/result_cache/hits"] >= 1
+    # A wall-clock-budgeted request is not cacheable.
+    bad = roundtrip(addr, {"op": "submit", "cache": True,
+                           "job": dict(BASE, max_seconds=1.0)})
+    assert not bad["ok"] and "max_seconds" in bad["error"]
+    # Submit without a proper inner job is a clean error.
+    bad = roundtrip(addr, {"op": "submit", "job": {"op": "nope"}})
+    assert not bad["ok"]
+
+
+def test_watch_job_sees_own_progress(jobsrv):
+    """Per-job run attach: the stream's snapshots carry THIS job's
+    registry state, ring progress attributed via the job-tagged
+    run_context (seq-ordered), and a done line with the terminal
+    job."""
+    from raft_tla_tpu.obs.flight import RECORDER
+    srv, _hist = jobsrv
+    addr = srv.server_address
+    seq0 = RECORDER.seq()
+    r = roundtrip(addr, {"op": "submit", "tenant": "t1",
+                         "job": dict(BASE, max_diameter=6)})
+    assert r["ok"], r
+    jid = r["job"]["id"]
+    got = []
+    with socket.create_connection(addr, timeout=600) as s:
+        s.sendall((json.dumps({"op": "watch", "job": jid,
+                               "interval": 0.1}) + "\n").encode())
+        s.settimeout(600)
+        for line in s.makefile("rb"):
+            rec = json.loads(line)
+            got.append(rec)
+            if rec.get("done"):
+                break
+    assert got[-1].get("done") and got[-1]["job"]["state"] == "done"
+    snaps = [g["watch"] for g in got if "watch" in g]
+    assert all(s["job"]["id"] == jid for s in snaps)
+    tagged = [s for s in snaps if s.get("run")]
+    assert tagged, "watch never saw the job's armed run"
+    assert all(s["run"]["job_id"] == jid and s["run"]["tenant"] == "t1"
+               for s in tagged)
+    fresh = [s["progress"] for s in snaps
+             if s.get("progress") and s["progress"]["seq"] > seq0]
+    assert fresh, "watch never saw this job's progress lines"
+    assert fresh[-1]["distinct"] > 0
+    # Watching an unknown job is a clean one-line error.
+    bad = roundtrip(addr, {"op": "watch", "job": "nope",
+                           "interval": 0.1})
+    assert not bad["ok"] and "unknown job" in bad["error"]
+
+
+def test_watch_outlives_idle_timeout_while_job_queued():
+    """ISSUE 13 satellite regression: a watcher attached to a QUEUED
+    job must not be reaped while the job is alive — neither by the
+    socket idle timeout nor by the count-0 no-run grace window, both
+    set well below the queue wait here.  The stream closes only on the
+    job's terminal state (a cancel, delivered to the watcher)."""
+    import tempfile
+    srv = srv_mod.serve(port=0, job_dir=tempfile.mkdtemp(),
+                        idle_timeout_seconds=0.6)
+    srv.watch_grace_seconds = 0.5
+    srv.jobs.close(wait=False)      # executor off: jobs stay queued
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    try:
+        addr = srv.server_address
+        r = roundtrip(addr, {"op": "submit", "tenant": "t",
+                             "job": dict(BASE, max_diameter=2)})
+        assert r["ok"], r
+        jid = r["job"]["id"]
+        got = []
+        t0 = time.monotonic()
+        with socket.create_connection(addr, timeout=60) as s:
+            s.sendall((json.dumps({"op": "watch", "job": jid,
+                                   "interval": 0.15}) + "\n").encode())
+            s.settimeout(60)
+            f = s.makefile("rb")
+            cancelled = False
+            for line in f:
+                rec = json.loads(line)
+                got.append(rec)
+                if rec.get("done"):
+                    break
+                elapsed = time.monotonic() - t0
+                if elapsed > 1.6 and not cancelled:
+                    # Well past both the 0.6 s idle timeout and the
+                    # 0.5 s grace: still streaming.  Now end the job.
+                    cancelled = True
+                    c = roundtrip(addr, {"op": "cancel", "job_id": jid})
+                    assert c["ok"], c
+        elapsed = time.monotonic() - t0
+        assert elapsed > 1.6, f"watcher reaped early ({elapsed:.2f}s)"
+        assert got[-1].get("done")
+        assert got[-1]["job"]["state"] == "cancelled"
+        queued = [g for g in got
+                  if g.get("watch", {}).get("job", {}).get("state")
+                  == "queued"]
+        assert len(queued) >= 6, len(queued)
+        # Plain (runless) count-0 watch: live queued jobs also hold it
+        # open past the grace window.
+        r2 = roundtrip(addr, {"op": "submit", "tenant": "t",
+                              "job": dict(BASE, max_diameter=2)})
+        assert r2["ok"]
+        n = 0
+        t0 = time.monotonic()
+        with socket.create_connection(addr, timeout=60) as s:
+            s.sendall((json.dumps({"op": "watch", "interval": 0.15})
+                       + "\n").encode())
+            s.settimeout(60)
+            f = s.makefile("rb")
+            for line in f:
+                rec = json.loads(line)
+                if rec.get("done"):
+                    pytest.fail("plain watch reaped while a job was "
+                                "queued")
+                n += 1
+                if time.monotonic() - t0 > 1.5:
+                    break               # still live well past grace
+        assert n >= 6
+    finally:
+        srv.shutdown()
+        srv.server_close()
+
+
+def test_server_restart_replays_job_journal(tmp_path):
+    """ISSUE 13 acceptance: a restart mid-queue replays the journal —
+    queued jobs resume on the new server and reach terminal states
+    with the pinned results, observable via the jobs op."""
+    jobdir = str(tmp_path / "jobs")
+    srv1 = srv_mod.serve(port=0, job_dir=jobdir)
+    srv1.jobs.close(wait=False)     # executor off: simulate dying mid-queue
+    t1 = threading.Thread(target=srv1.serve_forever, daemon=True)
+    t1.start()
+    addr1 = srv1.server_address
+    subs = []
+    for tenant, d in (("t1", 3), ("t2", 3)):
+        r = roundtrip(addr1, {"op": "submit", "tenant": tenant,
+                              "job": dict(BASE, max_diameter=d)})
+        assert r["ok"], r
+        subs.append(r["job"]["id"])
+    doc = roundtrip(addr1, {"op": "jobs"})
+    assert doc["by_state"]["queued"] == 2
+    srv1.shutdown()
+    srv1.server_close()
+    # The restarted server on the same --job-dir resumes the queue.
+    srv2 = srv_mod.serve(port=0, job_dir=jobdir)
+    t2 = threading.Thread(target=srv2.serve_forever, daemon=True)
+    t2.start()
+    try:
+        addr2 = srv2.server_address
+        doc = _wait_jobs_settled(addr2, subs)
+        assert doc["by_state"]["done"] == 2, doc
+        for jid in subs:
+            st = roundtrip(addr2, {"op": "status", "job_id": jid})
+            assert st["job"]["note"] == "resumed_after_restart"
+            res = roundtrip(addr2, {"op": "result", "job_id": jid})
+            assert res["result"]["distinct"] == 113
+    finally:
+        srv2.shutdown()
+        srv2.server_close()
