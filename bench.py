@@ -130,11 +130,12 @@ def main():
     # the coverage object's "pruned" column and the generated/distinct
     # headline — bench_diff.py then reports generated-state reduction
     # alongside the distinct/s regression gate.
-    # Successor pipeline (BENCH_PIPELINE=auto/v1/v2/v3): v3 is the fused
-    # Pallas chunk (ops/pipeline_v3.py) — on TPU the real fused kernels,
+    # Successor pipeline (BENCH_PIPELINE=auto/v1/v2/v3/v4): v3 is the
+    # fused Pallas chunk (ops/pipeline_v3.py), v4 the whole-chunk VMEM
+    # megakernel (ops/pipeline_v4.py) — on TPU the real fused kernels,
     # off-TPU interpret mode for the Pallas stages the platform policy
-    # keeps (the CI v2-vs-v3 gate runs this on CPU with fold-to-common
-    # stages in bench_diff.py).  The run's resolved pipeline + per-stage
+    # keeps (the CI v2-vs-v3/v4 gates run this on CPU with
+    # fold-to-common stages in bench_diff.py).  The run's resolved pipeline + per-stage
     # plan are embedded in the JSON so two benches are always
     # attributable.
     # Device-profiler capture (obs/profile.py XlaProfileCapture;

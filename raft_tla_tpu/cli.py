@@ -568,16 +568,20 @@ def main(argv=None):
                         help="mesh = shard over all visible devices (TLC "
                              "-workers / distributed TLC analog); auto = "
                              "mesh iff >1 accelerator device (default)")
-        sp.add_argument("--pipeline", choices=("auto", "v1", "v2", "v3"),
+        sp.add_argument("--pipeline",
+                        choices=("auto", "v1", "v2", "v3", "v4"),
                         default=None,
                         help="successor pipeline: v1 = classical expand, "
                              "v2 = delta (guards-only masks + delta "
                              "fingerprints), v3 = fused Pallas chunk "
                              "(VMEM-resident compact + probe/insert->"
-                             "enqueue tail; per-stage XLA fallback, "
-                             "interpret mode off-TPU).  auto = v2 where "
-                             "it applies (default; flag > cfg PIPELINE "
-                             "directive > auto)")
+                             "enqueue tail), v4 = whole-chunk VMEM "
+                             "megakernel (masks+POR+compact+fingerprint "
+                             "in ONE launch, then the v3 fused tail; "
+                             "per-stage XLA fallback, interpret mode "
+                             "off-TPU).  auto = v2 where it applies "
+                             "(default; flag > cfg PIPELINE directive "
+                             "> auto)")
 
     c = sub.add_parser("check", help="exhaustive BFS check")
     common(c)
@@ -838,7 +842,8 @@ def main(argv=None):
     sb.add_argument("--seed", type=int, default=0)
     sb.add_argument("--engine", choices=("single", "mesh", "auto"),
                     default=None)
-    sb.add_argument("--pipeline", choices=("auto", "v1", "v2", "v3"),
+    sb.add_argument("--pipeline",
+                    choices=("auto", "v1", "v2", "v3", "v4"),
                     default=None)
     sb.add_argument("--trace", action="store_true",
                     help="record the counterexample trace (the server "

@@ -106,12 +106,22 @@ class EngineConfig:
     # interpret mode off-TPU).  Bit-identical to v2 by contract; every
     # stage that cannot lower falls back to its XLA lowering
     # automatically, with the resolved per-stage plan recorded on
-    # ``EngineResult.fused_stages``.  Opt-in: "auto" never selects v3.
+    # ``EngineResult.fused_stages``.  "v4" = v2 semantics with the WHOLE
+    # chunk body fused into two Pallas megakernels (ops/pipeline_v4.py:
+    # the masks->compact->fingerprint front over the VMEM-resident
+    # parent window, plus v3's probe/insert->enqueue tail); same
+    # bit-identity and per-stage-fallback contract.  Opt-in: "auto"
+    # never selects v3/v4.
     pipeline: str = "auto"
     # Per-stage override for the v3 plan ({"compact": "pallas"|"xla",
     # "insert": "fused"|"xla", ...}) — tests force the full Pallas chain
     # on CPU through this; None = the platform policy.
     v3_force_stages: Optional[dict] = None
+    # Same for the v4 plan (ops/pipeline_v4.py _VALID; any front member
+    # forced off "fused" degrades the whole front group).  The
+    # RAFT_V4_FORCE env var merges over this, env winning per stage —
+    # the fallback-lattice tests' no-plumbing hook.
+    v4_force_stages: Optional[dict] = None
     # Lane-compaction lowering (ops/compact.py): "scatter" (original) or
     # "searchsorted" (binary-search inversion; identical outputs).  Kept
     # switchable until a TPU profile picks the winner.
@@ -326,12 +336,13 @@ class EngineResult:
     # duration clock, recorded as evidence for up-front SEEN_CAPACITY
     # sizing (each is a rehash + retrace on the growing engine).
     growth_stalls: List = dataclasses.field(default_factory=list)
-    # Which successor pipeline actually ran ("v1"/"v2"/"v3") — makes an
-    # ``auto`` fallback observable instead of a silent slowdown.
+    # Which successor pipeline actually ran ("v1"/"v2"/"v3"/"v4") —
+    # makes an ``auto`` fallback observable instead of a silent slowdown.
     pipeline: str = ""
-    # v3 only: the resolved per-stage lowering plan ({stage: "xla"|
-    # "pallas"|"fused"}, ops/pipeline_v3.py) — a stage that fell back
-    # to XLA is visible here, never a silent degradation.  {} for v1/v2.
+    # v3/v4 only: the resolved per-stage lowering plan ({stage: "xla"|
+    # "pallas"|"fused"}, ops/pipeline_v3.py / pipeline_v4.py) — a stage
+    # that fell back to XLA is visible here, never a silent
+    # degradation.  {} for v1/v2.
     fused_stages: Dict[str, str] = dataclasses.field(default_factory=dict)
     # ...and WHY each non-Pallas stage is what it is ({stage: reason}):
     # distinguishes a policy choice / explicit force from a kernel that
@@ -341,6 +352,13 @@ class EngineResult:
     # Certified ample instances the run's POR table carried (0 = POR off
     # or an all-conservative certificate — either way, full expansion).
     por_instances: int = 0
+    # BLEST-batched expansion grouping (models/actions.py
+    # family_groups): which action families share each stacked dense
+    # kernel and how many lanes each group contributes — static
+    # metadata, recorded so the batched-expansion win is attributable
+    # per family in the statespace report and the history ledger
+    # (ROADMAP item 2a's coverage tables).  [] before the grouping.
+    family_groups: List = dataclasses.field(default_factory=list)
     # Host-side per-phase wall-time breakdown for this run
     # ({phase: seconds}; obs/metrics.py phase timers): chunk dispatch,
     # stats fetch, trace flush, spill, fpset growth, checkpoint, ... —
@@ -530,22 +548,34 @@ def _resolve_pipeline(requested: str, dims):
     ``build_extra_v2`` can never silently degrade to the slow path.  The
     resolved choice is recorded on ``EngineResult.pipeline``.
 
-    "v3" shares v2's delta kernels (same semantics, hence the same
+    "v3"/"v4" share v2's delta kernels (same semantics, hence the same
     variant requirement and the same hard failure on one without v2
     kernels); the fused-stage plan on top is the engines' business
-    (ops/pipeline_v3.py)."""
+    (ops/pipeline_v3.py / ops/pipeline_v4.py)."""
     from ..models.actions2 import V2Unavailable, build_v2
     if requested == "v1":
         return None
-    if requested in ("v2", "v3"):
+    if requested in ("v2", "v3", "v4"):
         return build_v2(dims)   # raises if a variant lacks v2 kernels
     if requested != "auto":
         raise ValueError(
-            f"pipeline must be auto/v1/v2/v3, got {requested!r}")
+            f"pipeline must be auto/v1/v2/v3/v4, got {requested!r}")
     try:
         return build_v2(dims)
     except V2Unavailable:
         return None             # variant without build_extra_v2 -> v1
+
+
+def _family_groups_meta(dims, _v2=None):
+    """Static BLEST grouping metadata (models/actions.py
+    family_groups) for EngineResult/report/ledger attribution.
+    Fail-soft: a variant the grouper cannot describe yields [] — the
+    grouping is observability, never a failed engine build."""
+    try:
+        from ..models.actions import family_groups
+        return family_groups(dims)
+    except Exception:  # noqa: BLE001 — metadata only
+        return []
 
 
 def find_root_violation(root_check, encoded, init_states, batch_size,
@@ -621,12 +651,15 @@ class BFSEngine:
                     min(cfg.seen_capacity or (1 << 20), 1 << 22),
                     8 * prof_k),
                 compact_method=cfg.compact_method,
-                # v3 runs are profiled at the fused-stage granularity
-                # (masks / compact / fingerprint / insert_enqueue);
-                # v1/v2 keep the classical decomposition so the
-                # NORTHSTAR budget rows stay comparable across PRs.
-                pipeline="v3" if cfg.pipeline == "v3" else "v1",
-                v3_force=cfg.v3_force_stages,
+                # v3/v4 runs are profiled at the fused-stage
+                # granularity (v3: masks / compact / fingerprint /
+                # insert_enqueue; v4: front / insert_enqueue); v1/v2
+                # keep the classical decomposition so the NORTHSTAR
+                # budget rows stay comparable across PRs.
+                pipeline=(cfg.pipeline
+                          if cfg.pipeline in ("v3", "v4") else "v1"),
+                v3_force=(cfg.v4_force_stages if cfg.pipeline == "v4"
+                          else cfg.v3_force_stages),
                 every=prof_every, metrics=self.metrics)
         else:
             self._profiler = None
@@ -776,6 +809,7 @@ class BFSEngine:
         # everywhere else.  The split stages below stay exactly the v2
         # lowerings, so a fully-fallen-back v3 compiles the v2 program.
         fused_tail = None
+        fused_front = None
         enqueue_method = cfg.enqueue_method
         if cfg.pipeline == "v3":
             from ..ops import pipeline_v3
@@ -785,6 +819,25 @@ class BFSEngine:
                 force=cfg.v3_force_stages)
             if self._v3_plan.compactor is not None:
                 compactor = self._v3_plan.compactor
+            fused_tail = self._v3_plan.tail
+            enqueue_method = self._v3_plan.enqueue_method
+        elif cfg.pipeline == "v4":
+            # v4: the whole-chunk plan (ops/pipeline_v4.py) — the front
+            # megakernel needs the run's model context (v2 kernels,
+            # constraint, invariant list, POR arrays), which only this
+            # build site has.
+            from ..ops import pipeline_v4
+            self._v3_plan = pipeline_v4.resolve_plan(
+                B, G, K, Q=Q, sw=sw, mesh=False,
+                enqueue_method=cfg.enqueue_method,
+                force=cfg.v4_force_stages,
+                front_ctx={"dims": dims, "v2": self._v2,
+                           "constraint": constraint, "inv_fns": inv_fns,
+                           "por_mask": por_mask,
+                           "por_priority": por_priority})
+            if self._v3_plan.compactor is not None:
+                compactor = self._v3_plan.compactor
+            fused_front = self._v3_plan.front
             fused_tail = self._v3_plan.tail
             enqueue_method = self._v3_plan.enqueue_method
         else:
@@ -799,7 +852,7 @@ class BFSEngine:
             compactor=compactor, insert_fn=insert_fn, v2=self._v2,
             enqueue_method=enqueue_method,
             por_mask=por_mask, por_priority=por_priority,
-            fused_tail=fused_tail)
+            fused_tail=fused_tail, fused_front=fused_front)
 
         def chunk(qcur, cur_count, offset0, qnext, next_count, seen,
                   tbuf, tcount0, max_steps):
@@ -883,7 +936,8 @@ class BFSEngine:
                 for d in (jnp.uint32, jnp.uint32, jnp.uint32,
                           jnp.uint32, _I32))
             self._perf = perf_mod.build_accounting(
-                pipeline=("v3" if self._v3_plan is not None
+                pipeline=(cfg.pipeline
+                          if cfg.pipeline in ("v3", "v4")
                           else "v2" if self._v2 is not None
                           else "v1"),
                 chunk_fn=chunk,
@@ -891,7 +945,9 @@ class BFSEngine:
                              tbuf_av, i32, i32),
                 dims=dims, B=B, K=K,
                 compact_method=cfg.compact_method,
-                v3_force=cfg.v3_force_stages, plan=self._v3_plan,
+                v3_force=(cfg.v4_force_stages if cfg.pipeline == "v4"
+                          else cfg.v3_force_stages),
+                plan=self._v3_plan,
                 metrics=self.metrics)
         self._fp_rows = jax.jit(fp_rows)
         self._expand1 = jax.jit(expand)
@@ -1025,7 +1081,8 @@ class BFSEngine:
             context={
                 "engine": type(self).__name__, "dims": repr(self.dims),
                 "batch": cfg.batch, "resume": resume is not None,
-                "pipeline": ("v3" if getattr(self, "_v3_plan", None)
+                "pipeline": (cfg.pipeline
+                             if getattr(self, "_v3_plan", None)
                              is not None
                              else "v2" if getattr(self, "_v2", None)
                              is not None else "v1"),
@@ -1326,14 +1383,15 @@ class BFSEngine:
         elif init_states is None:
             raise ValueError("need init_states or resume")
         res = EngineResult(
-            pipeline=("v3" if self._v3_plan is not None
+            pipeline=(cfg.pipeline if self._v3_plan is not None
                       else "v2" if self._v2 is not None else "v1"),
             fused_stages=(dict(self._v3_plan.stages)
                           if self._v3_plan is not None else {}),
             fused_reasons=(dict(self._v3_plan.reasons)
                            if self._v3_plan is not None else {}),
             por_instances=(self._por_table.certified
-                           if self._por_table is not None else 0))
+                           if self._por_table is not None else 0),
+            family_groups=_family_groups_meta(dims, self._v2))
         self._cur_res = res     # run_end event reads it on error exits
         mt, evlog = self.metrics, self._evlog
         self._growth_stalls = res.growth_stalls

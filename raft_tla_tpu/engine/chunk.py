@@ -50,7 +50,8 @@ _I32 = jnp.int32
 def build_chunk_body(*, dims, expand, fingerprint, pack_ok, inv_fns,
                      constraint, B, G, K, Q, TQ, record_static, compactor,
                      insert_fn, v2=None, enqueue_method="scatter",
-                     por_mask=None, por_priority=None, fused_tail=None):
+                     por_mask=None, por_priority=None, fused_tail=None,
+                     fused_front=None):
     """Returns ``chunk_body(qcur, cur_count, carry) -> carry'``.
 
     ``Q`` is the live next-queue capacity (per chip for the mesh); masked
@@ -84,7 +85,19 @@ def build_chunk_body(*, dims, expand, fingerprint, pack_ok, inv_fns,
     ``v2`` (the fused kernel consumes the delta fingerprints); the
     constraint and row materialization move BEFORE the insert — they
     depend only on the compacted candidates, so every carry field stays
-    bit-identical to the split path (the tests' contract)."""
+    bit-identical to the split path (the tests' contract).
+
+    ``fused_front`` (the v4 pipeline, ops/pipeline_v4.py) replaces the
+    masks -> POR -> compact -> fingerprint/constraint/invariant section
+    with ONE Pallas megakernel ``(rows, valid) -> (en, ovf, pruned, P,
+    total, lane_id, kvalid, kh, kl, krows, cons_ok, inv, parent_hi,
+    parent_lo)`` (ops/chunk_front_pallas.py) whose body runs the SAME
+    model functions on the VMEM-resident parent window; ``en``/``ovf``
+    arrive already progress-limited, ``pruned`` pre-limit (this body
+    applies ``& ptaken`` when accounting, like the split path).
+    Requires ``v2``; the kernel bakes in the POR arrays and the
+    constraint/invariant dispatch, so those arguments must describe the
+    same run."""
     if enqueue_method not in ("scatter", "window", "pallas"):
         raise ValueError(f"unknown enqueue method {enqueue_method!r}")
     if (por_mask is None) != (por_priority is None):
@@ -110,6 +123,8 @@ def build_chunk_body(*, dims, expand, fingerprint, pack_ok, inv_fns,
                 f"{por_mask.dtype} / {por_priority.dtype}")
     if fused_tail is not None and v2 is None:
         raise ValueError("fused_tail (v3) requires the v2 delta pipeline")
+    if fused_front is not None and v2 is None:
+        raise ValueError("fused_front (v4) requires the v2 delta pipeline")
     BG = B * G
     inv_id = build_inv_id(inv_fns) if inv_fns else None
 
@@ -122,83 +137,117 @@ def build_chunk_body(*, dims, expand, fingerprint, pack_ok, inv_fns,
          fam_pruned) = carry
         rows = jax.lax.dynamic_slice_in_dim(qcur, offset, B, axis=0)
         valid = (offset + jnp.arange(B, dtype=_I32)) < cur_count
-        states = jax.vmap(unflatten_state, (0, None))(rows, dims)
-        if v2 is None:
-            cands, en, ovf = jax.vmap(expand)(states)
-            en = en & valid[:, None]
-            # A successor whose term/bag count outgrew the uint8 row is an
-            # overflow too (schema.build_pack_guard): stop, never alias.
-            ovf = (ovf | (en & ~jax.vmap(jax.vmap(pack_ok))(cands))) \
-                & valid[:, None]
+        parent_hi = parent_lo = None
+        if fused_front is not None:
+            # v4: one Pallas megakernel runs masks -> POR -> compact ->
+            # delta fingerprints -> constraint/invariants on the
+            # VMEM-resident parent window.  en/ovf arrive already
+            # progress-limited; pruned is pre-limit (accounted below
+            # like the split path); the per-lane parent fingerprints
+            # feed the trace recorder without re-reading the parents.
+            (en, ovf, pruned, P, total, lane_id, kvalid, kh, kl, krows,
+             cons_ok, inv, parent_hi, parent_lo) = fused_front(
+                 rows, valid)
+            if por_mask is None:
+                pruned = None
+            ptaken = jnp.arange(B, dtype=_I32) < P
         else:
-            # Masks fold the pack guard in at the same lanes (actions2).
-            en, ovf = jax.vmap(v2.masks)(states)
-            en = en & valid[:, None]
-            ovf = ovf & valid[:, None]
+            states = jax.vmap(unflatten_state, (0, None))(rows, dims)
+            if v2 is None:
+                cands, en, ovf = jax.vmap(expand)(states)
+                en = en & valid[:, None]
+                # A successor whose term/bag count outgrew the uint8 row
+                # is an overflow too (schema.build_pack_guard): stop,
+                # never alias.
+                ovf = (ovf | (en & ~jax.vmap(jax.vmap(pack_ok))(cands))) \
+                    & valid[:, None]
+            else:
+                # Masks fold the pack guard in at the same lanes
+                # (actions2).
+                en, ovf = jax.vmap(v2.masks)(states)
+                en = en & valid[:, None]
+                ovf = ovf & valid[:, None]
 
-        if por_mask is not None:
-            # Partial-order reduction (analysis/por.py table): keep ONE
-            # certified ample lane per state that has any, masking its
-            # siblings before compaction/fingerprinting — the reduction
-            # the coverage tables account as "pruned".  Rows with no
-            # certified enabled instance are untouched, so a state with
-            # an empty enabled set still reads as a deadlock.
-            amp = en & por_mask[None, :]
-            any_amp = jnp.any(amp, axis=1)
-            pri = jnp.where(amp, por_priority[None, :],
-                            jnp.int32(2147483647))
-            sel = jnp.argmin(pri, axis=1)
-            keep = jnp.where(
-                any_amp[:, None],
-                jnp.arange(G, dtype=_I32)[None, :] == sel[:, None],
-                jnp.ones((B, G), bool))
-            pruned = en & ~keep
-            en = en & keep
-            ovf = ovf & keep
-        else:
-            pruned = None
+            if por_mask is not None:
+                # Partial-order reduction (analysis/por.py table): keep
+                # ONE certified ample lane per state that has any,
+                # masking its siblings before compaction/fingerprinting
+                # — the reduction the coverage tables account as
+                # "pruned".  Rows with no certified enabled instance are
+                # untouched, so a state with an empty enabled set still
+                # reads as a deadlock.
+                amp = en & por_mask[None, :]
+                any_amp = jnp.any(amp, axis=1)
+                pri = jnp.where(amp, por_priority[None, :],
+                                jnp.int32(2147483647))
+                sel = jnp.argmin(pri, axis=1)
+                keep = jnp.where(
+                    any_amp[:, None],
+                    jnp.arange(G, dtype=_I32)[None, :] == sel[:, None],
+                    jnp.ones((B, G), bool))
+                pruned = en & ~keep
+                en = en & keep
+                ovf = ovf & keep
+            else:
+                pruned = None
 
-        # Progress limiting + lane compaction (ops/compact.py): take the
-        # longest parent prefix whose fan-out fits K, compact the enabled
-        # lanes to K slots — nothing is ever dropped, a fan-out burst
-        # just advances fewer parents this step.
-        P, total, lane_id, kvalid = compactor(en)
-        ptaken = jnp.arange(B, dtype=_I32) < P
-        en = en & ptaken[:, None]
-        ovf = ovf & ptaken[:, None]
+            # Progress limiting + lane compaction (ops/compact.py): take
+            # the longest parent prefix whose fan-out fits K, compact
+            # the enabled lanes to K slots — nothing is ever dropped, a
+            # fan-out burst just advances fewer parents this step.
+            P, total, lane_id, kvalid = compactor(en)
+            ptaken = jnp.arange(B, dtype=_I32) < P
+            en = en & ptaken[:, None]
+            ovf = ovf & ptaken[:, None]
+
+            # Everything below — fingerprinting included — runs on the K
+            # compacted lanes only: gather the candidate structs first,
+            # hash after (identical to hashing the packed rows whenever
+            # pack_ok holds, and any overflow aborts the run above).
+            # Hashing before compaction would read every field of all
+            # B*G lanes for the ~94% that are disabled.
+            if v2 is None:
+                cflat = jax.tree.map(
+                    lambda a: a.reshape((BG,) + a.shape[2:]), cands)
+                kstates = jax.tree.map(lambda a: a[lane_id], cflat)
+                kh, kl = jax.vmap(fingerprint)(kstates)     # [K]
+            else:
+                # Gather K parent structs (from B parents, not B*G
+                # candidate lanes) and construct only those successors,
+                # with their fingerprints coming from the parents' hash
+                # sums + per-lane deltas (models/actions2.py).
+                ph = jax.vmap(v2.parent_hash)(states)
+                pidx = lane_id // G
+                kparents = jax.tree.map(lambda a: a[pidx], states)
+                kph = jax.tree.map(lambda a: a[pidx], ph)
+                kh, kl, kstates = jax.vmap(v2.lane_out)(
+                    kparents, kph, lane_id % G)
+
+            if constraint is not None:
+                cons_ok = jax.vmap(constraint)(kstates)
+            else:
+                cons_ok = jnp.ones((K,), bool)
+            krows = jax.vmap(flatten_state, (0, None))(kstates, dims)
+            # Invariant dispatch depends only on the candidates, so it
+            # sits before the insert on both paths (the v4 kernel
+            # computes it in-kernel; values are insert-independent).
+            if inv_id is not None:
+                inv = jax.vmap(inv_id)(kstates)
+            else:
+                inv = jnp.full((K,), -1, _I32)
+            if record_static:
+                if v2 is None:
+                    php, plp = jax.vmap(fingerprint)(states)  # [B]
+                else:
+                    php, plp = jax.vmap(v2.parent_fp)(ph)
+                parent_hi = php[lane_id // G]
+                parent_lo = plp[lane_id // G]
+
         dead_b = valid & ptaken & ~jnp.any(en, axis=1) \
             & ~jnp.any(ovf, axis=1)
         dead_any_b = jnp.any(dead_b)
         drow_b = rows[jnp.argmax(dead_b)]
 
-        # Everything below — fingerprinting included — runs on the K
-        # compacted lanes only: gather the candidate structs first, hash
-        # after (identical to hashing the packed rows whenever pack_ok
-        # holds, and any overflow aborts the run above).  Hashing before
-        # compaction would read every field of all B*G lanes for the
-        # ~94% that are disabled.
-        if v2 is None:
-            cflat = jax.tree.map(
-                lambda a: a.reshape((BG,) + a.shape[2:]), cands)
-            kstates = jax.tree.map(lambda a: a[lane_id], cflat)
-            kh, kl = jax.vmap(fingerprint)(kstates)         # [K]
-        else:
-            # Gather K parent structs (from B parents, not B*G candidate
-            # lanes) and construct only those successors, with their
-            # fingerprints coming from the parents' hash sums + per-lane
-            # deltas (models/actions2.py).
-            ph = jax.vmap(v2.parent_hash)(states)
-            pidx = lane_id // G
-            kparents = jax.tree.map(lambda a: a[pidx], states)
-            kph = jax.tree.map(lambda a: a[pidx], ph)
-            kh, kl, kstates = jax.vmap(v2.lane_out)(
-                kparents, kph, lane_id % G)
-
-        if constraint is not None:
-            cons_ok = jax.vmap(constraint)(kstates)
-        else:
-            cons_ok = jnp.ones((K,), bool)
-        krows = jax.vmap(flatten_state, (0, None))(kstates, dims)
         if fused_tail is not None:
             # v3: one Pallas kernel probes/inserts the K keys and
             # appends each novel constraint-passing row at the running
@@ -210,10 +259,6 @@ def build_chunk_body(*, dims, expand, fingerprint, pack_ok, inv_fns,
                 seen, kh, kl, kvalid, krows, cons_ok, next_count, qnext)
         else:
             seen, new, fail = insert_fn(seen, kh, kl, kvalid)
-        if inv_id is not None:
-            inv = jax.vmap(inv_id)(kstates)
-        else:
-            inv = jnp.full((K,), -1, _I32)
         viol = new & (inv >= 0)
         viol_any_b = jnp.any(viol)
         vpos = jnp.argmax(viol)
@@ -255,12 +300,6 @@ def build_chunk_body(*, dims, expand, fingerprint, pack_ok, inv_fns,
         next_count = next_count + jnp.sum(enq, dtype=_I32)
 
         if record_static:
-            if v2 is None:
-                php, plp = jax.vmap(fingerprint)(states)  # parent fps [B]
-            else:
-                php, plp = jax.vmap(v2.parent_fp)(ph)
-            parent_hi = php[lane_id // G]
-            parent_lo = plp[lane_id // G]
             actions = lane_id % G
             if enqueue_method == "scatter":
                 tpos = jnp.where(

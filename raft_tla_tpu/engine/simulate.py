@@ -247,17 +247,24 @@ class Simulator:
     # ------------------------------------------------------------------
     def _reconstruct(self, res: SimResult, roots, vinv, vroot, vlen,
                      vacts, vchoice):
-        """Replay the latched (root, action sequence) through the kernels."""
+        """Replay the latched (root, action sequence) through the kernels.
+
+        The encoded candidate row is threaded through the loop directly:
+        re-encoding each decoded PyState would reassign message slots
+        (frozenset order), and slot-indexed action ids (Receive /
+        Duplicate / Drop) recorded against the walker's slot layout
+        would then address the wrong message mid-replay."""
         state = roots[vroot]
+        st = encode_state(state, self.dims)
         trace = [(-1, state)]
         for g in list(vacts[:vlen]) + [vchoice]:
             g = int(g)
-            st = encode_state(state, self.dims)
             cands, en, _ovf = self._expand1(st)
             if g < 0 or not bool(np.asarray(en)[g]):
                 break
             row = jax.tree.map(lambda a: np.asarray(a)[g], cands)
-            state = decode_state(StateBatch(*row), self.dims)
+            st = StateBatch(*row)
+            state = decode_state(st, self.dims)
             trace.append((g, state))
         res.violation_state = state
         res.violation_trace = trace

@@ -26,6 +26,7 @@ from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from .dims import (AEQ, AER, CANDIDATE, FOLLOWER, LEADER, NIL, RVQ, RVR,
                    RaftDims)
@@ -33,6 +34,54 @@ from .schema import StateBatch
 
 _TRUE = jnp.bool_(True)
 _FALSE = jnp.bool_(False)
+
+# BLEST-style expansion groups (PAPERS.md #1; ROADMAP item 2a): base
+# families sharing a parameter shape are STACKED into one dense
+# dispatch — one vmap over the concatenated parameter grid with a
+# per-lane family selector — instead of one vmapped kernel per family.
+# Indices are positions in the build_kernels list; the grouping is
+# sound because the grouped kernels are pure functions of (state,
+# params) — the slot-precise dependence matrices (analysis/effects.py,
+# PR 11's 3469 proven-independent pairs) certify the families never
+# observe each other within a step, so evaluating them jointly on the
+# stacked grid and masking by selector is value-identical to the
+# per-family loop.  Kept module-level so family_groups() (the
+# report/ledger metadata) and _build (the executed dispatch) cannot
+# drift apart.
+_BASE_GROUPS = (
+    ("server", (0, 1, 3, 5)),         # Restart/Timeout/BecomeLeader/ACI (i,)
+    ("server_pair", (2, 6)),          # RequestVote/AppendEntries (i, j)
+    ("server_value", (4,)),           # ClientRequest (i, v)
+    ("slot", (7, 8, 9)),              # Receive/Duplicate/Drop (s,)
+)
+_BASE_FAMILY_NAMES = ("Restart", "Timeout", "RequestVote", "BecomeLeader",
+                      "ClientRequest", "AdvanceCommitIndex",
+                      "AppendEntries", "Receive", "DuplicateMessage",
+                      "DropMessage")
+
+
+def family_groups(dims: RaftDims):
+    """Static description of the batched-expansion grouping: one dict
+    per stacked dispatch, ``{"group", "families", "kernels", "lanes"}``
+    with ``kernels`` the number of family kernels stacked into the
+    group's dense dispatch and ``lanes`` its instance-grid width.
+    Recorded on EngineResult/report/history so the BLEST win stays
+    attributable per family.  Extra (variant) families are singleton
+    groups — their parameter grids are theirs alone."""
+    names = list(dims.family_names)
+    sizes = list(dims.family_sizes)
+    if tuple(names[:10]) != _BASE_FAMILY_NAMES:
+        # A variant that rewrites the base alphabet gets the honest
+        # ungrouped description rather than a mislabeled stacking.
+        return [{"group": n, "families": [n], "kernels": 1,
+                 "lanes": int(s)} for n, s in zip(names, sizes)]
+    out = [{"group": gname, "families": [names[m] for m in members],
+            "kernels": len(members),
+            "lanes": int(sum(sizes[m] for m in members))}
+           for gname, members in _BASE_GROUPS]
+    out += [{"group": names[k], "families": [names[k]], "kernels": 1,
+             "lanes": int(sizes[k])} for k in range(10, len(names))]
+    return out
 
 
 def _sel(cond, then_tree, else_tree):
@@ -389,17 +438,74 @@ def _build(dims: RaftDims):
                                            dims.extra_families):
         kernels.append((name, kern, tuple(params)))
 
+    # -- BLEST-batched dispatch (_BASE_GROUPS) ----------------------------
+    # Families sharing a parameter shape run as ONE stacked dense kernel:
+    # the group's parameter grids concatenate, a per-lane selector picks
+    # the family, and every member kernel is evaluated densely with the
+    # result masked in by a where-cascade (branch-free, MXU/VPU-friendly
+    # — the BLEST formulation).  The selected lane's value is exactly
+    # ``kern(st, *its_own_params)``, so the grid stays bit-identical to
+    # the per-family loop; a static permutation restores
+    # dims.family_offsets order after the group-major concatenation.
+    if tuple(n for n, _k, _p in kernels[:10]) == _BASE_FAMILY_NAMES:
+        groups = [(g, list(m)) for g, m in _BASE_GROUPS]
+        groups += [(kernels[k][0], [k]) for k in range(10, len(kernels))]
+    else:   # rewritten base alphabet: honest per-family dispatch
+        groups = [(kernels[k][0], [k]) for k in range(len(kernels))]
+    sizes = [int(p[0].shape[0]) for _n, _k, p in kernels]
+
+    def _make_group(members):
+        kerns = [kernels[m][1] for m in members]
+
+        def gk(st, which, *params):
+            en, ovf, new = kerns[0](st, *params)
+            for idx in range(1, len(kerns)):
+                e2, o2, n2 = kerns[idx](st, *params)
+                take = which == idx
+                en = jnp.where(take, e2, en)
+                ovf = jnp.where(take, o2, ovf)
+                new = _sel(take, n2, new)
+            return en, ovf, new
+
+        return gk
+
+    grouped = []
+    for _gname, members in groups:
+        if len(members) == 1:
+            name, kern, params = kernels[members[0]]
+            grouped.append((jax.vmap(kern, (None,) + (0,) * len(params)),
+                            params))
+        else:
+            nparam = len(kernels[members[0]][2])
+            stacked = tuple(
+                jnp.concatenate([kernels[m][2][a] for m in members])
+                for a in range(nparam))
+            which = jnp.concatenate([
+                jnp.full((sizes[m],), gi, i32)
+                for gi, m in enumerate(members)])
+            grouped.append((
+                jax.vmap(_make_group(members),
+                         (None, 0) + (0,) * nparam),
+                (which,) + stacked))
+    # Final lane f (family order) lives at perm[f] in the group-major
+    # concatenation; identity when the grouping degenerates to
+    # one-family-per-group.
+    gorder = [m for _g, members in groups for m in members]
+    starts, pos = {}, 0
+    for f in gorder:
+        starts[f], pos = pos, pos + sizes[f]
+    perm = np.concatenate([
+        np.arange(starts[f], starts[f] + sizes[f])
+        for f in range(len(kernels))])
+
     def expand(st: StateBatch):
         """All candidate successors of one state.  Returns
         (cands [G,...], enabled [G], overflow [G]) with G = n_instances,
         ordered per dims.family_offsets."""
-        outs = []
-        for _name, kern, params in kernels:
-            in_axes = (None,) + (0,) * len(params)
-            outs.append(jax.vmap(kern, in_axes)(st, *params))
-        enabled = jnp.concatenate([o[0] for o in outs])
-        overflow = jnp.concatenate([o[1] for o in outs])
-        cands = jax.tree.map(lambda *xs: jnp.concatenate(xs),
+        outs = [gfn(st, *args) for gfn, args in grouped]
+        enabled = jnp.concatenate([o[0] for o in outs])[perm]
+        overflow = jnp.concatenate([o[1] for o in outs])[perm]
+        cands = jax.tree.map(lambda *xs: jnp.concatenate(xs)[perm],
                              *(o[2] for o in outs))
         return cands, enabled, overflow
 

@@ -241,15 +241,22 @@ def resolve_baseline(path: str, host_fp: Optional[dict],
 
 
 def perf_columns(entry: dict):
-    """(launches/chunk, advisor-top) from an entry's embedded bench
-    perf block (obs/perf.py) — or the xplane_summary dialect, which
-    embeds the same block shape.  (None, None) for entries predating
-    the metric, so the trajectory renders '--' instead of guessing."""
+    """(launches/chunk, advisor-top, peak bandwidth fraction) from an
+    entry's embedded bench perf block (obs/perf.py) — or the
+    xplane_summary dialect, which embeds the same block shape.  The
+    bandwidth fraction is the MAX across the profiled roofline stages
+    (the most saturated stage — what a v3/v4 fusion round is trying to
+    raise).  (None, None, None) for entries predating the metric, so
+    the trajectory renders '--' instead of guessing."""
     bench = entry.get("bench") or {}
     perf = bench.get("perf") or {}
     lpc = (perf.get("launch") or {}).get("launches_per_chunk")
     top = (perf.get("advisor") or {}).get("top")
-    return lpc, top
+    stages = ((perf.get("roofline") or {}).get("stages") or {})
+    fracs = [r.get("bandwidth_fraction") for r in stages.values()
+             if isinstance(r, dict)
+             and r.get("bandwidth_fraction") is not None]
+    return lpc, top, (max(fracs) if fracs else None)
 
 
 def render_table(entries: List[dict], perf: bool = False) -> str:
@@ -257,10 +264,13 @@ def render_table(entries: List[dict], perf: bool = False) -> str:
     entry, host-key column + explicit flags where adjacent entries are
     NOT rate-comparable (different or unknown host) — the r05 trap,
     rendered impossible to miss.  ``perf=True`` adds the performance-
-    observatory columns (launches/chunk + advisor pick) so the
-    trajectory shows whether fusion work is actually RETIRING launches
-    across rounds, not just moving wall-clock."""
-    pcols = (f" {'launch/chunk':>12s} {'advisor':14s}") if perf else ""
+    observatory columns (pipeline + launches/chunk + peak bandwidth
+    fraction + advisor pick) so the trajectory shows whether fusion
+    work (v3's fused tail, v4's megakernel) is actually RETIRING
+    launches and raising saturation across rounds, not just moving
+    wall-clock."""
+    pcols = (f" {'pipe':>4s} {'launch/chunk':>12s} {'bw-frac':>8s} "
+             f"{'advisor':14s}") if perf else ""
     lines = [f"{'#':>3s} {'label':20s} {'kind':9s} {'host':10s} "
              f"{'distinct/s':>12s} {'distinct':>12s} {'diam':>5s} "
              f"{'verdict':10s}{pcols} flags"]
@@ -291,9 +301,12 @@ def render_table(entries: List[dict], perf: bool = False) -> str:
                   else f" {'--':>5s}")
                + f" {str(e.get('verdict') or '?'):10s}")
         if perf:
-            lpc, top = perf_columns(e)
-            row += ((f" {lpc:12,.0f}" if isinstance(lpc, (int, float))
-                     else f" {'--':>12s}")
+            lpc, top, bw = perf_columns(e)
+            row += (f" {str(e.get('pipeline') or '--'):>4s}"
+                    + (f" {lpc:12,.0f}" if isinstance(lpc, (int, float))
+                       else f" {'--':>12s}")
+                    + (f" {bw:8.1%}" if isinstance(bw, (int, float))
+                       else f" {'--':>8s}")
                     + f" {str(top or '--'):14s}")
         row += " " + (",".join(flags) if flags else "-")
         lines.append(row)

@@ -320,7 +320,8 @@ def build_accounting(*, pipeline: str, chunk_fn, chunk_avals,
         launch_model = analyze_chunk_program(chunk_fn, *chunk_avals)
         if with_stages and dims is not None:
             traffic = roofline_mod.stage_traffic(
-                dims, B, K, pipeline="v3" if pipeline == "v3" else "v1",
+                dims, B, K,
+                pipeline=pipeline if pipeline in ("v3", "v4") else "v1",
                 compact_method=compact_method, v3_force=v3_force)
     except Exception as e:
         print(f"perf: {engine} launch/roofline model unavailable "

@@ -41,8 +41,14 @@ engine is not executing:
     fingerprint     delta fingerprints + K-lane sparse rows
     insert_enqueue  the fused probe/insert -> DMA-append tail
 
-``scripts/bench_diff.py`` folds the two granularities onto common
-coarse stages when diffing across pipelines.
+``pipeline="v4"`` narrows further to the v4 megakernel granularity
+(ops/pipeline_v4.py) — two fused launches per chunk:
+
+    front           masks + POR + compact + fingerprint megakernel
+    insert_enqueue  the fused probe/insert -> DMA-append tail
+
+``scripts/bench_diff.py`` folds the granularities onto common coarse
+stages when diffing across pipelines.
 
 jax is imported lazily (constructor), keeping ``obs`` importable in
 device-less tooling like the rest of the package.
@@ -56,6 +62,7 @@ from typing import Dict, Optional
 
 STAGES = ("expand", "fingerprint", "dedup_insert", "enqueue")
 STAGES_V3 = ("masks", "compact", "fingerprint", "insert_enqueue")
+STAGES_V4 = ("front", "insert_enqueue")
 
 STAGE_PREFIX = "chunk_stage/"
 
@@ -232,6 +239,89 @@ def build_stage_programs_v3(dims, B: int, K: int,
     }
 
 
+def build_stage_programs_v4(dims, B: int, K: int,
+                            compact_method: str = "scatter",
+                            force: Optional[dict] = None) -> dict:
+    """Stage programs at the v4 megakernel granularity (STAGES_V4).
+
+    ``front`` is the whole-chunk VMEM megakernel (masks + compact +
+    delta fingerprint in one Pallas launch); ``insert_enqueue`` is the
+    same fused tail v3 runs.  When the front group degraded (forced or
+    the kernel failed to build), the profiled ``front`` stand-in is the
+    v3-style split chain so its timing still covers the same work.
+    ``force`` must be the engine's ``EngineConfig.v4_force_stages``.
+    Constraint/invariant hooks are not mirrored (profiler scratch runs
+    have none), matching the v3 profiler's all-true ``cons``.  Same
+    return shape as ``build_stage_programs``."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..models.actions2 import build_v2
+    from ..models.schema import flatten_state, state_width, unflatten_state
+    from ..ops import fpset
+    from ..ops import pipeline_v4
+    from ..ops.compact import build_compactor
+
+    _I32 = jnp.int32
+    G = dims.n_instances
+    v2 = build_v2(dims)
+    QP = K
+    plan = pipeline_v4.resolve_plan(
+        B, G, K, Q=QP, sw=state_width(dims), force=force,
+        front_ctx={"dims": dims, "v2": v2, "constraint": None,
+                   "inv_fns": None, "por_mask": None,
+                   "por_priority": None})
+    compactor = plan.compactor or build_compactor(B, G, K,
+                                                  method=compact_method)
+
+    if plan.front is not None:
+        def s_front(rows, valid):
+            (_en, _ovf, _pruned, _P, _total, lane_id, kvalid, kh, kl,
+             krows, _cons, _inv, _phi, _plo) = plan.front(rows, valid)
+            return lane_id, kvalid, kh, kl, krows
+    else:
+        def s_front(rows, valid):
+            states = jax.vmap(unflatten_state, (0, None))(rows, dims)
+            en, _ovf = jax.vmap(v2.masks)(states)
+            en = en & valid[:, None]
+            _P, _total, lane_id, kvalid = compactor(en)
+            ph = jax.vmap(v2.parent_hash)(states)
+            pidx = lane_id // G
+            kparents = jax.tree.map(lambda a: a[pidx], states)
+            kph = jax.tree.map(lambda a: a[pidx], ph)
+            kh, kl, kstates = jax.vmap(v2.lane_out)(kparents, kph,
+                                                    lane_id % G)
+            krows = jax.vmap(flatten_state, (0, None))(kstates, dims)
+            return lane_id, kvalid, kh, kl, krows
+
+    def s_tail(seen, kh, kl, kvalid, krows, qnext):
+        cons = jnp.ones((K,), bool)
+        if plan.tail is not None:
+            seen, new, fail, qnext = plan.tail(
+                seen, kh, kl, kvalid, krows, cons, jnp.int32(0), qnext)
+        else:
+            seen, new, fail = fpset.insert(seen, kh, kl, kvalid)
+            pos = jnp.cumsum(new.astype(_I32)) - 1
+            pos = jnp.where(new, pos, QP + jnp.arange(K, dtype=_I32))
+            qnext = qnext.at[pos].set(krows, mode="drop")
+        return seen, qnext, new, fail
+
+    def s_total(rows, valid, seen, qnext):
+        _lane_id, kvalid, kh, kl, krows = s_front(rows, valid)
+        seen, qnext, new, _fail = s_tail(seen, kh, kl, kvalid, krows,
+                                         qnext)
+        return seen, qnext, jnp.sum(new, dtype=_I32)
+
+    return {
+        "front": jax.jit(s_front),
+        "insert_enqueue": jax.jit(s_tail),
+        "total": jax.jit(s_total),
+        "queue_rows": 2 * QP,
+        "empty_seen": lambda cap: fpset.empty(cap),
+        "plan": plan,
+    }
+
+
 class ChunkProfiler:
     """Samples every ``every``-th chunk call of one engine run.
 
@@ -248,17 +338,19 @@ class ChunkProfiler:
         self.B, self.K = int(batch), int(lanes)
         self.seen_capacity = int(seen_capacity)
         self.compact_method = compact_method
-        # The engine's EngineConfig.v3_force_stages, so the profiled v3
-        # stage lowerings are exactly the ones the engine runs.
+        # The engine's EngineConfig.v3_force_stages (or v4_force_stages
+        # when pipeline="v4"), so the profiled stage lowerings are
+        # exactly the ones the engine runs.
         self.v3_force = v3_force
         # "v1" = the classical NORTHSTAR-budget decomposition (default,
-        # cross-pipeline comparable); "v3" = the fused-stage
-        # decomposition the v3 chunk actually executes.
-        if pipeline not in ("v1", "v3"):
-            raise ValueError(f"profiler pipeline must be v1/v3, "
+        # cross-pipeline comparable); "v3"/"v4" = the fused-stage
+        # decomposition that chunk actually executes.
+        if pipeline not in ("v1", "v3", "v4"):
+            raise ValueError(f"profiler pipeline must be v1/v3/v4, "
                              f"got {pipeline!r}")
         self.pipeline = pipeline
-        self.stages = STAGES_V3 if pipeline == "v3" else STAGES
+        self.stages = {"v3": STAGES_V3,
+                       "v4": STAGES_V4}.get(pipeline, STAGES)
         self.every = max(1, int(every))
         self.metrics = metrics
         self.samples = 0
@@ -290,6 +382,10 @@ class ChunkProfiler:
             progs = build_stage_programs_v3(self.dims, self.B, self.K,
                                             self.compact_method,
                                             force=self.v3_force)
+        elif self.pipeline == "v4":
+            progs = build_stage_programs_v4(self.dims, self.B, self.K,
+                                            self.compact_method,
+                                            force=self.v3_force)
         else:
             progs = build_stage_programs(self.dims, self.B, self.K,
                                          self.compact_method)
@@ -312,6 +408,14 @@ class ChunkProfiler:
         when ``fence`` is given (the shared driver for warm-up and
         sampling; one sequence per stage granularity)."""
         fence = fence or (lambda stage, out: out)
+        if self.pipeline == "v4":
+            lane_id, kvalid, kh, kl, krows = fence(
+                "front", progs["front"](rows, valid))
+            self._seen_staged, self._qnext, new, fail = fence(
+                "insert_enqueue", progs["insert_enqueue"](
+                    self._seen_staged, kh, kl, kvalid, krows,
+                    self._qnext))
+            return fail
         if self.pipeline == "v3":
             states, en = fence("masks", progs["masks"](rows, valid))
             lane_id, kvalid = fence("compact", progs["compact"](en))

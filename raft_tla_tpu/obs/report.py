@@ -119,12 +119,19 @@ def build_report(result, coverage=None, level_stats=None,
     if loads:
         seen["load_curve_final"] = loads[-1]
 
+    # BLEST family-group attribution (models/actions.py): which action
+    # families ride each stacked expansion kernel, so a per-family win
+    # (or regression) is attributable to its group.
+    fam_groups = [dict(g) for g in
+                  (getattr(result, "family_groups", None) or [])]
+
     return {
         "distinct": distinct,
         "generated": generated,
         "diameter": int(getattr(result, "diameter", 0)),
         "stop_reason": getattr(result, "stop_reason", None),
         "verdict": verdict,
+        "family_groups": fam_groups,
         "collision": {
             "calculated": collision_probability(distinct, generated),
             "formula": "distinct * (generated - distinct) / 2^64",
@@ -206,6 +213,13 @@ def render_report(report: dict) -> str:
              if seen.get("growths") else "")
         lines.append(f"  seen-set: final load {seen['final_load']:.3f} "
                      f"of {seen['capacity']:,} keys{g}")
+    groups = report.get("family_groups") or []
+    if groups:
+        total_k = sum(g["kernels"] for g in groups)
+        parts = ", ".join(f"{g['group']}={g['kernels']}k/{g['lanes']}l"
+                          for g in groups)
+        lines.append(f"  expansion groups: {len(groups)} stacked groups, "
+                     f"{total_k} member kernels ({parts})")
     return "\n".join(lines)
 
 
@@ -217,7 +231,7 @@ def summarize(report: Optional[dict]) -> dict:
         return {}
     peak = report.get("frontier_peak") or {}
     od = report.get("out_degree") or {}
-    return {
+    out = {
         "collision_calculated": report["collision"]["calculated"],
         "diameter": report["diameter"],
         "verdict": report["verdict"],
@@ -225,3 +239,9 @@ def summarize(report: Optional[dict]) -> dict:
         "frontier_peak": peak.get("frontier"),
         "mean_out_degree": od.get("mean"),
     }
+    groups = report.get("family_groups") or []
+    if groups:
+        # Compact per-group projection: kernel count per stacked group,
+        # so the ledger shows HOW batched the expansion was per run.
+        out["family_groups"] = {g["group"]: g["kernels"] for g in groups}
+    return out

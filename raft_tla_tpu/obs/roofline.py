@@ -356,8 +356,9 @@ def stage_traffic(dims, B: int, K: int, *, pipeline: str = "v1",
                   compact_method: str = "scatter", v3_force=None,
                   seen_capacity: int = 1 << 14) -> Dict[str, dict]:
     """{stage: traffic dict} for the ChunkProfiler's stage programs —
-    v1 granularity (expand/fingerprint/dedup_insert/enqueue) or the v3
-    fused-stage granularity, matching ``chunk_stages`` keys so measured
+    v1 granularity (expand/fingerprint/dedup_insert/enqueue), the v3
+    fused-stage granularity, or the v4 megakernel granularity
+    (front/insert_enqueue), matching ``chunk_stages`` keys so measured
     means and modeled floors join by name.  Trace-only (eval_shape
     chains the stage signatures); nothing executes or compiles.
 
@@ -372,6 +373,9 @@ def stage_traffic(dims, B: int, K: int, *, pipeline: str = "v1",
 
     if pipeline == "v3":
         progs = profile_mod.build_stage_programs_v3(
+            dims, B, K, compact_method, force=v3_force)
+    elif pipeline == "v4":
+        progs = profile_mod.build_stage_programs_v4(
             dims, B, K, compact_method, force=v3_force)
     else:
         progs = profile_mod.build_stage_programs(dims, B, K,
@@ -390,7 +394,13 @@ def stage_traffic(dims, B: int, K: int, *, pipeline: str = "v1",
     seen = jax.eval_shape(lambda: fpset.empty(seen_capacity))
     qnext = jax.ShapeDtypeStruct((progs["queue_rows"], sw), jnp.uint8)
     out: Dict[str, dict] = {}
-    if pipeline == "v3":
+    if pipeline == "v4":
+        lane_id, kvalid, kh, kl, krows = jax.eval_shape(
+            progs["front"], rows, valid)
+        out["front"] = traced(progs["front"], rows, valid)
+        out["insert_enqueue"] = traced(progs["insert_enqueue"], seen, kh,
+                                       kl, kvalid, krows, qnext)
+    elif pipeline == "v3":
         states, en = jax.eval_shape(progs["masks"], rows, valid)
         out["masks"] = traced(progs["masks"], rows, valid)
         lane_id, kvalid = jax.eval_shape(progs["compact"], en)

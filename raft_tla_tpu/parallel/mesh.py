@@ -54,9 +54,9 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..engine.chunk import build_chunk_body
 from ..engine.bfs import (EngineConfig, EngineResult, TraceStore, Violation,
-                          _exit_condition_hit, _progress_line,
-                          build_root_check, find_root_violation,
-                          make_trace_store)
+                          _exit_condition_hit, _family_groups_meta,
+                          _progress_line, build_root_check,
+                          find_root_violation, make_trace_store)
 from ..models.actions import build_expand
 from ..models.dims import RaftDims
 from ..models.invariants import build_inv_id
@@ -284,6 +284,17 @@ class MeshBFSEngine:
                 enqueue_method=cfg.enqueue_method,
                 force=cfg.v3_force_stages)
             enqueue_method = self._v3_plan.enqueue_method
+        elif cfg.pipeline == "v4":
+            # v4 on the mesh degrades to the v3 arrangement (the plan
+            # records why: the front's compact P is pmin-replicated and
+            # the dedup is an all_to_all — collectives cannot live in
+            # the megakernels), so front/tail stay None here.
+            from ..ops import pipeline_v4
+            self._v3_plan = pipeline_v4.resolve_plan(
+                B, G, K, Q=QL, sw=sw, mesh=True,
+                enqueue_method=cfg.enqueue_method,
+                force=cfg.v4_force_stages)
+            enqueue_method = self._v3_plan.enqueue_method
         else:
             self._v3_plan = None
 
@@ -450,7 +461,8 @@ class MeshBFSEngine:
                 for d in (jnp.uint32, jnp.uint32, jnp.uint32,
                           jnp.uint32, _I32))
             self._perf = perf_mod.build_accounting(
-                pipeline=("v3" if self._v3_plan is not None
+                pipeline=(cfg.pipeline
+                          if cfg.pipeline in ("v3", "v4")
                           else "v2" if self._v2 is not None
                           else "v1"),
                 chunk_fn=self._chunk,
@@ -745,14 +757,15 @@ class MeshBFSEngine:
             self._trace_run_id = mh.build_min(self.mesh)(
                 int(time.time() * 1000) & 0x7FFFFFFF)
         res = EngineResult(
-            pipeline=("v3" if self._v3_plan is not None
+            pipeline=(cfg.pipeline if self._v3_plan is not None
                       else "v2" if self._v2 is not None else "v1"),
             fused_stages=(dict(self._v3_plan.stages)
                           if self._v3_plan is not None else {}),
             fused_reasons=(dict(self._v3_plan.reasons)
                            if self._v3_plan is not None else {}),
             por_instances=(self._por_table.certified
-                           if self._por_table is not None else 0))
+                           if self._por_table is not None else 0),
+            family_groups=_family_groups_meta(self.dims))
         self._cur_res = res     # run_end event reads it on error exits
         mt, evlog = self.metrics, self._evlog
         self._growth_stalls = res.growth_stalls

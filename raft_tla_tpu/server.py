@@ -262,8 +262,9 @@ def _do_check(req, telemetry=None):
         max_diameter=req.get("max_diameter"),
         record_trace=record_trace,
         check_deadlock=req.get("check_deadlock"),
-        # Successor pipeline (auto/v1/v2/v3 — v3 is the fused Pallas
-        # chunk); same request-over-directive precedence as every key.
+        # Successor pipeline (auto/v1/v2/v3/v4 — v3 is the fused
+        # Pallas chunk, v4 the whole-chunk megakernel); same
+        # request-over-directive precedence as every key.
         pipeline=(req["pipeline"] if req.get("pipeline") is not None
                   else base.pipeline),
         por=(bool(req["por"]) if req.get("por") is not None

@@ -34,8 +34,9 @@ SPILL_DIR, TRACE_DIR, PROGRESS_SECONDS, EVENTS_OUT, KEEP_CHECKPOINTS,
 TRACE_OUT (Chrome-trace span file), PROFILE_CHUNKS (per-stage chunk
 profiling cadence), POR (statically-certified partial-order reduction),
 POR_TABLE (pre-certified reduction-table artifact path), PIPELINE
-(successor pipeline: auto / v1 / v2 / v3 — v3 is the fused Pallas chunk,
-engine/bfs.py EngineConfig.pipeline), XLA_PROFILE (device-profiler
+(successor pipeline: auto / v1 / v2 / v3 / v4 — v3 is the fused Pallas
+chunk, v4 the whole-chunk VMEM megakernel; engine/bfs.py
+EngineConfig.pipeline), XLA_PROFILE (device-profiler
 capture: trace the first N chunk calls through jax.profiler,
 obs/profile.py XlaProfileCapture), METRICS_PORT (serve /metrics
 Prometheus exposition + /flight live snapshots over HTTP for the run,

@@ -205,12 +205,15 @@ def diff_phases(old: dict, new: dict, d: Diff, max_regress: float,
 
 
 # chunk_stages key -> coarse common stage, across every profiler
-# granularity (obs/profile.py STAGES and STAGES_V3).  "front" is
-# everything before the fingerprint (v1's expand row already folds
-# compaction in; v3 splits masks/compact), "tail" everything after it.
+# granularity (obs/profile.py STAGES, STAGES_V3, STAGES_V4).  "front"
+# is everything up to and including the fingerprint (v1's expand row
+# already folds compaction in; v3 splits masks/compact; v4's megakernel
+# row covers the whole trio — folding the fingerprint into "front"
+# everywhere keeps all three granularities comparable), "tail" is
+# everything after it.
 STAGE_FOLD = {
     "expand": "front", "masks": "front", "compact": "front",
-    "fingerprint": "fingerprint",
+    "fingerprint": "front", "front": "front",
     "dedup_insert": "tail", "enqueue": "tail", "insert_enqueue": "tail",
     "total": "total",
 }

@@ -131,6 +131,44 @@ else
     echo "skipped: tunnel dead"
 fi
 
+echo "== 2d. bench --pipeline v4 (whole-chunk megakernel, 60 s) =="
+# The v4 wall-clock verdict: the front megakernel (masks + POR +
+# compact + delta fingerprints in ONE Mosaic launch) + the fused tail
+# against BOTH the v2 XLA chunk (stage 2) and the v3 split-fused chunk
+# (stage 2c).  The CI launch pin already proves v4 retires >75% of
+# v2's device ops statically; this stage is where that has to cash out
+# as states/s on real hardware.  Same degradation story as 2c: a
+# Mosaic failure on any stage falls back per plan (fused_stages in the
+# JSON names what actually ran), so a partial lowering measures as
+# itself instead of wedging the session.
+if probe; then
+    BENCH_SECONDS=60 BENCH_PIPELINE=v4 BENCH_ORACLE_SECONDS=1 \
+        timeout 900 python bench.py \
+        2> artifacts/bench_tpu_v4.log | tee artifacts/bench_tpu_v4.json \
+        || echo "bench v4 stage failed (rc=$?)"
+    python scripts/bench_diff.py artifacts/bench_tpu.json \
+        artifacts/bench_tpu_v4.json \
+        | tee artifacts/bench_tpu_v2_vs_v4.txt
+    case $? in
+        0) echo "(v4 holds or beats v2 on this hardware)" ;;
+        1) echo "(v4 regressed vs v2 on this hardware — see diff above)" ;;
+        *) echo "(v2-vs-v4 diff UNAVAILABLE: bench JSON malformed or" \
+                "missing — a crashed measurement, not a perf verdict)" ;;
+    esac
+    python scripts/bench_diff.py artifacts/bench_tpu_v3.json \
+        artifacts/bench_tpu_v4.json \
+        | tee artifacts/bench_tpu_v3_vs_v4.txt
+    case $? in
+        0) echo "(v4 holds or beats v3 on this hardware)" ;;
+        1) echo "(v4 regressed vs v3 — the megakernel loses to the" \
+                "split-fused chunk here; see diff above)" ;;
+        *) echo "(v3-vs-v4 diff UNAVAILABLE: bench JSON malformed or" \
+                "missing)" ;;
+    esac
+else
+    echo "skipped: tunnel dead"
+fi
+
 echo "== 3. leader-rich bench (60 s) =="
 if probe; then
     timeout 900 python scripts/leader_bench.py 60 \
@@ -172,7 +210,7 @@ else
     echo "skipped: tunnel dead"
 fi
 
-echo "== 5b. device-profiler capture (--xla-profile, v2 then v3) =="
+echo "== 5b. device-profiler capture (--xla-profile, v2/v3/v4) =="
 # The NORTHSTAR §d hardware verdict needs to see INSIDE the chunk
 # program (kernel launches, HBM traffic) — jax.profiler artifacts
 # (XPlane + Perfetto trace), correlated with the host spans by the
@@ -180,7 +218,7 @@ echo "== 5b. device-profiler capture (--xla-profile, v2 then v3) =="
 # first 16 chunk calls; even a session cut right after this stage has
 # the hardware profile for both pipelines.
 if probe; then
-    for pipe in v2 v3; do
+    for pipe in v2 v3 v4; do
         timeout 600 python -m raft_tla_tpu check \
             configs/MCraft_bounded.cfg ${PLAT_ARGS} --max-seconds 60 \
             --no-trace --pipeline "$pipe" --xla-profile 16 \
@@ -197,7 +235,7 @@ if probe; then
     # bench trajectory instead of staying a profiler screenshot —
     # bench_diff --launch-drift can then gate v2-vs-v3 on MEASURED
     # launch counts.
-    for pipe in v2 v3; do
+    for pipe in v2 v3 v4; do
         python scripts/xplane_summary.py "artifacts/xla_profile_${pipe}" \
             --out "artifacts/xplane_summary_${pipe}.json" \
             --history artifacts/history.jsonl \
@@ -208,6 +246,11 @@ if probe; then
         artifacts/xplane_summary_v3.json \
         | tee artifacts/xplane_v2_vs_v3.txt \
         || echo "xplane v2-vs-v3 launch diff: rc=$? (1 = launch "\
+"regression verdict, 2 = unreadable capture)"
+    python scripts/bench_diff.py artifacts/xplane_summary_v2.json \
+        artifacts/xplane_summary_v4.json \
+        | tee artifacts/xplane_v2_vs_v4.txt \
+        || echo "xplane v2-vs-v4 launch diff: rc=$? (1 = launch "\
 "regression verdict, 2 = unreadable capture)"
 else
     echo "skipped: tunnel dead"

@@ -57,10 +57,13 @@ def pytest_collection_modifyitems(config, items):
         # test_fused builds several whole engines (v2 + two v3 plans +
         # a mesh) back to back — the same trace-churn profile, so it
         # runs in the same trailing slot.
-        # test_perf traces full chunk programs (all three pipelines +
+        # test_perf traces full chunk programs (all four pipelines +
         # a mesh) through the analyzer walk — same churn, same slot.
+        # test_v4 builds a v2 baseline plus the forced-fallback engine
+        # lattice — the heaviest engine-churn module of all.
         return ("test_analysis" in it.nodeid or "test_por" in it.nodeid
-                or "test_fused" in it.nodeid or "test_perf" in it.nodeid)
+                or "test_fused" in it.nodeid or "test_perf" in it.nodeid
+                or "test_v4" in it.nodeid)
 
     analysis = [it for it in items if heavy(it)]
     if analysis and len(analysis) < len(items):

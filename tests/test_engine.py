@@ -172,6 +172,7 @@ def test_levels_match_pinned_oracle_profile():
     assert res.violation is None
 
 
+@pytest.mark.slow   # ~2 min CPU differential; nightly/hardware tier
 def test_five_server_north_star_model_matches_oracle():
     """The north-star model (configs/TPUraft.cfg: 5 servers, MaxTerm=4,
     MaxLogLen=4) against a pinned oracle prefix — extends the
@@ -433,6 +434,7 @@ def test_generated_budget_stops_run(tmp_path):
     assert res.generated > 2000
 
 
+@pytest.mark.slow   # ~3 min CPU spill stress; nightly/hardware tier
 def test_spillpool_midscale_profile(tmp_path):
     """Mid-scale spill stress (VERDICT r3 weak #2): ~795k distinct states
     through a deliberately small queue so the level-11 frontier (548,904

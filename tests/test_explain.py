@@ -290,6 +290,7 @@ def test_cli_explain_renders_and_exports_graph(tmp_path, capsys):
 # check writes a rendered counterexample whose decoded states match the
 # oracle replay exactly (the acceptance criterion, satellite 4).
 
+@pytest.mark.slow   # ~1.5 min CPU; tier-1 keeps the depth-limited explain tests
 def test_pinned_violation_cfg_renders_and_matches_oracle(tmp_path):
     from raft_tla_tpu.engine.check import run_check
     repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))

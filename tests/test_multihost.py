@@ -44,6 +44,16 @@ def _run_pair(script, timeout=900, extra_env=None):
             for q in procs:
                 q.kill()
             pytest.fail("multi-host worker timed out (collective deadlock?)")
+        if p.returncode != 0 and \
+                "aren't implemented on the CPU backend" in err:
+            # This jaxlib build lacks multiprocess collectives on the
+            # CPU backend (gloo path not compiled in) — an environment
+            # capability, not a code regression.  Real worker failures
+            # still assert below.
+            for q in procs:
+                q.kill()
+            pytest.skip("jaxlib CPU backend lacks multiprocess "
+                        "collectives in this environment")
         assert p.returncode == 0, f"worker failed:\n{err[-3000:]}"
         outs.append(json.loads(out.strip().splitlines()[-1]))
     return sorted(outs, key=lambda r: r["process"])

@@ -145,15 +145,22 @@ def test_roofline_rows_and_advisor():
 #: intentional change re-pins with the delta explained in its PR.  The
 #: v3 pin sits BELOW v2 by the fused tail's retired split-path ops: the
 #: fused probe/insert->enqueue kernel replacing the XLA insert + row
-#: scatter is directly visible here.
+#: scatter is directly visible here.  The v1 pin moved 1948 -> 2119
+#: with the BLEST family grouping (models/actions.py): the stacked
+#: group kernels add where-cascade selects to the PRE-fusion eqn count
+#: while cutting the per-family launch fan-out XLA must schedule.  The
+#: v4 pin is the megakernel story: the whole front (masks + compact +
+#: fingerprint) plus the fused tail collapse ~2900 device ops into two
+#: Pallas launches + the fixed chunk scaffolding.
 LAUNCH_PINS = {
-    "v1": {"launches_per_batch": 1948, "launches_fixed": 6},
+    "v1": {"launches_per_batch": 2119, "launches_fixed": 6},
     "v2": {"launches_per_batch": 3178, "launches_fixed": 6},
     "v3": {"launches_per_batch": 3050, "launches_fixed": 6},
+    "v4": {"launches_per_batch": 257, "launches_fixed": 6},
 }
 
 
-@pytest.mark.parametrize("pipe", ["v1", "v2", "v3"])
+@pytest.mark.parametrize("pipe", ["v1", "v2", "v3", "v4"])
 def test_launch_counts_pinned_per_pipeline(pipe):
     eng = BFSEngine(DIMS, constraint=build_constraint(DIMS, BOUNDS),
                     config=small_config(perf=True, pipeline=pipe))
@@ -175,6 +182,14 @@ def test_v3_fused_tail_retires_launches():
         < LAUNCH_PINS["v2"]["launches_per_batch"]
 
 
+def test_v4_megakernel_quarter_of_v2():
+    """ISSUE 15 acceptance criterion as an assertion: v4's static
+    per-chunk device-op count must be at MOST 25% of v2's — the
+    megakernel's whole point.  (Measured: ~8%.)"""
+    assert LAUNCH_PINS["v4"]["launches_per_batch"] \
+        <= 0.25 * LAUNCH_PINS["v2"]["launches_per_batch"]
+
+
 def test_v3_plan_reports_stage_launches():
     from raft_tla_tpu.models.schema import state_width
     from raft_tla_tpu.ops import pipeline_v3
@@ -190,6 +205,39 @@ def test_v3_plan_reports_stage_launches():
                                       sw=state_width(DIMS),
                                       force={"insert": "xla"})
     assert forced.launches["insert"] is None
+
+
+def test_v4_plan_reports_stage_launches():
+    """v4 plan launch accounting: a built front is ONE launch covering
+    masks/compact/fingerprint (the grouped stages count 0), the fused
+    tail one more; degrading any front member nulls the whole group
+    (XLA ops counted by the jaxpr walk instead)."""
+    from raft_tla_tpu.models.actions2 import build_v2
+    from raft_tla_tpu.models.schema import state_width
+    from raft_tla_tpu.ops import pipeline_v4
+    G = DIMS.n_instances
+    ctx = {"dims": DIMS, "v2": build_v2(DIMS), "constraint": None,
+           "inv_fns": None, "por_mask": None, "por_priority": None}
+    plan = pipeline_v4.resolve_plan(B, G, K, Q=4096,
+                                    sw=state_width(DIMS), front_ctx=ctx)
+    assert plan.stages == {"masks": "fused", "compact": "fused",
+                           "fingerprint": "fused", "insert": "fused",
+                           "enqueue": "fused"}
+    assert plan.launches["masks"] == 1
+    assert plan.launches["compact"] == 0
+    assert plan.launches["fingerprint"] == 0
+    assert plan.launches["insert"] == 1
+    assert plan.launches["enqueue"] == 0
+    degraded = pipeline_v4.resolve_plan(B, G, K, Q=4096,
+                                        sw=state_width(DIMS),
+                                        front_ctx=ctx,
+                                        force={"compact": "xla"})
+    assert degraded.front is None
+    assert degraded.launches["masks"] is None
+    # shape-only resolve (no build context) degrades with a reason
+    shp = pipeline_v4.resolve_plan(B, G, K, Q=4096, sw=state_width(DIMS))
+    assert shp.front is None
+    assert any("front" in r for r in shp.reasons.values())
 
 
 # ---------------------------------------------------------------------------

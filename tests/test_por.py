@@ -433,6 +433,7 @@ def test_forced_table_render_table_shows_pruned():
     assert snap["A"]["pruned"] == 3 and snap["B"]["pruned"] == 0
 
 
+@pytest.mark.slow   # ~2 min CPU; tier-1 keeps the L0-L6 differentials
 def test_oracle_differential_pinned_L0_L9(real_table):
     """The acceptance differential on the pinned MCraft_bounded L0-L9
     ground truths (scripts/oracle_exhaust.py, oracle_exhaust.jsonl

@@ -8,6 +8,8 @@ import os
 import subprocess
 import sys
 
+import pytest
+
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
@@ -23,6 +25,7 @@ def run_script(args, extra_env=None, timeout=600):
     return proc.stdout
 
 
+@pytest.mark.slow   # ~2 min CPU; the hardware form is tpu_session stage 3
 def test_profile_step_runs():
     out = run_script(["scripts/profile_step.py", "64"])
     assert "expand" in out and "insert" in out
@@ -35,12 +38,14 @@ def test_profile_fpset_runs():
     assert "hash insert" in out
 
 
+@pytest.mark.slow   # ~1 min CPU; hardware form is tpu_session stage 2
 def test_true_bench_runs():
     out = run_script(["scripts/true_bench.py"],
                      extra_env={"TB_BATCH": "64"})
     assert "ms/iter" in out
 
 
+@pytest.mark.slow   # ~2 min CPU; hardware form is tpu_session stage 4
 def test_leader_bench_runs():
     """The leader-rich bench must actually exercise the log-machinery
     kernels (ClientRequest/AppendEntries/AdvanceCommitIndex > 0 is asserted
@@ -63,6 +68,7 @@ def test_oracle_exhaust_level_capped(tmp_path):
     assert rec["diameter"] == 2
 
 
+@pytest.mark.slow   # ~1 min CPU; bench.py is exercised by the CI bench_diff steps
 def test_bench_runs_with_tiny_budget():
     out = run_script(["bench.py"], extra_env={"BENCH_SECONDS": "3"},
                      timeout=900)

@@ -1,0 +1,314 @@
+"""v4 whole-chunk megakernel pipeline: plan policy + fallback lattice.
+
+The v4 chunk (ops/pipeline_v4.py + ops/chunk_front_pallas.py) is the v2
+delta pipeline with both halves fused: ONE front Pallas launch covering
+masks + POR + compact + delta fingerprints (the parent-struct window
+never leaves VMEM), then the same fused probe/insert->enqueue tail v3
+ships.  Contracts proven here:
+
+- the plan resolves the front as an atomic stage GROUP (forcing or
+  failing any of masks/compact/fingerprint degrades all three, after
+  which compact re-resolves per the v3 platform policy), fused tail and
+  mesh constraints as in v3, with a recorded reason per non-fused stage;
+- the RAFT_V4_FORCE env override ("stage=impl,...") merges over
+  ``EngineConfig.v4_force_stages`` with env winning per stage — the
+  no-plumbing hook the lattice test uses;
+- the FALLBACK LATTICE: every v4 stage individually forced to its XLA
+  fallback stays bit-identical to v2 on the pinned oracle prefix —
+  counts, levels, and the recorded trace-link set — so degradation is
+  invisible except to the launch accounting;
+- mesh dryrun: --pipeline v4 on the virtual 8-device mesh (front
+  degraded by the collective constraint) matches v2 exactly;
+- the BLEST family grouping (models/actions.py family_groups) is
+  attributed end-to-end: EngineResult.family_groups -> statespace
+  report -> history-ledger summary.
+
+Depth-limited prefixes keep tier-1 affordable (the full pinned L0-L9
+and 46,553-state mesh dryrun differentials run the identical code
+paths at more depth — verified at PR time, recorded in CHANGES.md).
+Listed in tests/conftest.py's trace-heavy-last reorder: this module
+builds more whole engines back to back than any other.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from raft_tla_tpu.engine.bfs import BFSEngine, EngineConfig
+from raft_tla_tpu.models.invariants import build_constraint
+from raft_tla_tpu.ops import pipeline_v4
+from raft_tla_tpu.utils.cfg import load_config
+
+# ---------------------------------------------------------------------------
+# Stage-plan resolution
+
+
+def _front_ctx(dims):
+    from raft_tla_tpu.models.actions2 import build_v2
+    return {"dims": dims, "v2": build_v2(dims), "constraint": None,
+            "inv_fns": None, "por_mask": None, "por_priority": None}
+
+
+def test_v4_plan_policy_and_reasons():
+    setup = load_config("configs/MCraft_bounded.cfg")
+    dims = setup.dims
+    from raft_tla_tpu.models.schema import state_width
+    B, G, K = 16, dims.n_instances, 256
+    sw = state_width(dims)
+    ctx = _front_ctx(dims)
+
+    plan = pipeline_v4.resolve_plan(B, G, K, Q=512, sw=sw, front_ctx=ctx)
+    assert plan.front is not None and plan.tail is not None
+    assert plan.stages == {s: "fused" for s in pipeline_v4.STAGES}
+    assert pipeline_v4.describe(plan).startswith("masks=fused")
+
+    # Forcing ANY front member degrades the whole group (the megakernel
+    # has no partial configuration)...
+    for member in pipeline_v4.FRONT_STAGES:
+        deg = pipeline_v4.resolve_plan(B, G, K, Q=512, sw=sw,
+                                       front_ctx=ctx,
+                                       force={member: "xla"})
+        assert deg.front is None
+        for s in pipeline_v4.FRONT_STAGES:
+            assert deg.stages[s] != "fused"
+            assert member in deg.reasons[s] or "forced" in deg.reasons[s]
+        # ...but the tail stays fused independently.
+        assert deg.stages["insert"] == "fused"
+
+    # Shape-only resolve (profiler probes, mesh precheck) degrades the
+    # front with the no-context reason, never an exception.
+    shp = pipeline_v4.resolve_plan(B, G, K, Q=512, sw=sw)
+    assert shp.front is None
+    assert "front" in shp.reasons["masks"]
+
+    # Mesh: collectives keep both the front and the insert on XLA,
+    # enqueue on the shard_map Pallas path — the v3 arrangement.
+    mesh_plan = pipeline_v4.resolve_plan(B, G, K, Q=512, sw=sw,
+                                         mesh=True, front_ctx=ctx)
+    assert mesh_plan.front is None and mesh_plan.tail is None
+    assert "collective" in mesh_plan.reasons["masks"]
+    assert mesh_plan.stages["insert"] == "xla"
+    assert mesh_plan.stages["enqueue"] == "pallas"
+
+    # Typo'd force raises — a silently-ignored override would let a
+    # forced-fallback differential pass vacuously.
+    with pytest.raises(ValueError, match="v4_force_stages"):
+        pipeline_v4.resolve_plan(B, G, K, Q=512, sw=sw,
+                                 force={"masks": "Fused"})
+    with pytest.raises(ValueError, match="v4_force_stages"):
+        pipeline_v4.resolve_plan(B, G, K, Q=512, sw=sw,
+                                 force={"front": "xla"})
+
+
+def test_v4_env_force_overrides_config(monkeypatch):
+    """RAFT_V4_FORCE merges over the config dict with env winning per
+    stage; malformed env entries raise instead of silently running the
+    kernel the test meant to disable."""
+    monkeypatch.setenv(pipeline_v4.ENV_FORCE, "insert=xla")
+    merged = pipeline_v4._merged_force({"insert": "fused",
+                                        "compact": "xla"})
+    assert merged == {"insert": "xla", "compact": "xla"}
+    plan = pipeline_v4.resolve_plan(16, 132, 256, Q=512, sw=40)
+    assert plan.tail is None
+    assert plan.stages["insert"] == "xla"
+    monkeypatch.setenv(pipeline_v4.ENV_FORCE, "insert")
+    with pytest.raises(ValueError, match="RAFT_V4_FORCE"):
+        pipeline_v4.resolve_plan(16, 132, 256, Q=512, sw=40)
+
+
+def test_v4_plan_falls_back_when_front_cannot_build(monkeypatch):
+    """A front kernel that cannot even construct must degrade the group
+    to XLA with the failure recorded, never fail the engine build."""
+    from raft_tla_tpu.ops import chunk_front_pallas as cfp
+    setup = load_config("configs/MCraft_bounded.cfg")
+    dims = setup.dims
+    from raft_tla_tpu.models.schema import state_width
+
+    def boom(**kw):
+        raise RuntimeError("no mosaic for you")
+
+    monkeypatch.setattr(cfp, "build_front", boom)
+    plan = pipeline_v4.resolve_plan(16, dims.n_instances, 256, Q=512,
+                                    sw=state_width(dims),
+                                    front_ctx=_front_ctx(dims))
+    assert plan.front is None
+    assert "no mosaic for you" in plan.reasons["masks"]
+    assert plan.stages["insert"] == "fused"   # tail unaffected
+
+
+def test_v4_requires_v2_kernels():
+    """pipeline='v4' on a dims variant without v2 kernels must raise
+    (same rule as v3: never silently run the slow path when asked to
+    fuse)."""
+    from raft_tla_tpu.engine.bfs import _resolve_pipeline
+    from raft_tla_tpu.models.actions2 import V2Unavailable
+    from raft_tla_tpu.models.dims import RaftDims
+
+    class NoV2(RaftDims):
+        @property
+        def extra_families(self):
+            return (("Mystery", 2),)
+
+    nov2 = NoV2(n_servers=2, n_values=1, max_log=2, n_msg_slots=8)
+    with pytest.raises(V2Unavailable):
+        _resolve_pipeline("v4", nov2)
+
+
+# ---------------------------------------------------------------------------
+# Engine-level differentials: the fallback lattice
+
+
+def _run(dims, bounds, pipe, depth, force=None, env=None,
+         monkeypatch=None):
+    from raft_tla_tpu.models.pystate import init_state
+    if env is not None:
+        monkeypatch.setenv(pipeline_v4.ENV_FORCE, env)
+    try:
+        eng = BFSEngine(
+            dims, constraint=build_constraint(dims, bounds),
+            config=EngineConfig(batch=128, queue_capacity=1 << 14,
+                                seen_capacity=1 << 16, record_trace=True,
+                                check_deadlock=False, max_diameter=depth,
+                                pipeline=pipe, v4_force_stages=force))
+        res = eng.run([init_state(dims)])
+        tf, tp, ta = eng.trace.export()
+        links = set(zip(tf.tolist(), tp.tolist(), ta.tolist()))
+        return res, links
+    finally:
+        if env is not None:
+            monkeypatch.delenv(pipeline_v4.ENV_FORCE)
+
+
+def test_v4_engine_matches_v2_pinned_prefix():
+    """Single-chip --pipeline v4 (both megakernels fused) vs v2 through
+    L6 (pinned oracle: 9,457 cumulative distinct): same counts, levels,
+    verdict, AND the same recorded trace-link set."""
+    setup = load_config("configs/MCraft_bounded.cfg")
+    dims = setup.dims
+    out = {}
+    for pipe in ("v2", "v4"):
+        res, links = _run(dims, setup.bounds, pipe, 6)
+        assert res.distinct == 9457      # pinned oracle L6 cumulative
+        out[pipe] = (res.distinct, res.generated, res.levels,
+                     res.diameter, links)
+        if pipe == "v4":
+            assert res.pipeline == "v4"
+            assert res.fused_stages == {s: "fused"
+                                        for s in pipeline_v4.STAGES}
+    assert out["v2"] == out["v4"]
+
+
+@pytest.mark.slow   # five extra engine builds; nightly tier — tier-1
+                    # keeps the all-fused prefix + mesh differentials
+def test_v4_fallback_lattice_bit_identical(monkeypatch):
+    """EVERY v4 stage individually forced to its XLA fallback via the
+    RAFT_V4_FORCE env override stays bit-identical to v2 on the pinned
+    prefix — counts, levels, and trace links.  Depth 4 keeps five extra
+    engine builds affordable; the stage kernels run every chunk either
+    way."""
+    setup = load_config("configs/MCraft_bounded.cfg")
+    dims = setup.dims
+    base, base_links = _run(dims, setup.bounds, "v2", 4)
+    want = (base.distinct, base.generated, base.levels, base_links)
+    for stage in pipeline_v4.STAGES:
+        res, links = _run(dims, setup.bounds, "v4", 4,
+                          env=f"{stage}=xla", monkeypatch=monkeypatch)
+        got = (res.distinct, res.generated, res.levels, links)
+        assert got == want, f"forcing {stage}=xla broke bit-identity"
+        assert res.fused_stages[stage] != "fused"
+        if stage in pipeline_v4.FRONT_STAGES:
+            # the whole front group degraded together
+            assert all(res.fused_stages[s] != "fused"
+                       for s in pipeline_v4.FRONT_STAGES)
+            assert res.fused_stages["insert"] == "fused"
+
+
+def test_v4_mesh_matches_v2():
+    """Mesh --pipeline v4 on the virtual 8-device mesh: the front
+    degrades by the collective constraint, results match v2 exactly —
+    the dryrun-path acceptance differential at tier-1 depth."""
+    from raft_tla_tpu.models.dims import RaftDims
+    from raft_tla_tpu.models.invariants import Bounds
+    from raft_tla_tpu.models.pystate import init_state
+    from raft_tla_tpu.parallel.mesh import MeshBFSEngine
+    dims = RaftDims(n_servers=3, n_values=2, max_log=4, n_msg_slots=24)
+    bounds = Bounds(max_term=2, max_log_len=1, max_msg_count=1)
+    out = {}
+    for pipe in ("v2", "v4"):
+        eng = MeshBFSEngine(
+            dims, constraint=build_constraint(dims, bounds),
+            config=EngineConfig(batch=16, queue_capacity=1 << 12,
+                                seen_capacity=1 << 15,
+                                check_deadlock=False, max_diameter=3,
+                                pipeline=pipe))
+        res = eng.run([init_state(dims)])
+        out[pipe] = (res.distinct, res.generated, res.levels)
+        if pipe == "v4":
+            assert res.pipeline == "v4"
+            assert res.fused_stages["masks"] == "xla"
+            assert res.fused_stages["enqueue"] == "pallas"
+    assert out["v2"] == out["v4"]
+
+
+# ---------------------------------------------------------------------------
+# Profiler granularity + BLEST family-group attribution
+
+
+def test_v4_profiler_front_granularity():
+    """--profile-chunks on a v4 engine samples the megakernel
+    decomposition (front / insert_enqueue) and the result carries the
+    v4 keys bench_diff folds."""
+    from raft_tla_tpu.engine.check import initial_states, make_engine
+    setup = load_config("configs/MCraft_bounded.cfg")
+    eng = make_engine(setup, EngineConfig(
+        batch=32, queue_capacity=1 << 12, seen_capacity=1 << 14,
+        record_trace=False, check_deadlock=False, max_diameter=3,
+        pipeline="v4", profile_chunks_every=1))
+    res = eng.run(initial_states(setup))
+    assert set(res.chunk_stages) == {"front", "insert_enqueue", "total"}
+    prof = eng._profiler
+    assert prof.summary()["pipeline"] == "v4"
+    assert "front" in prof.render_table()
+
+
+def test_family_groups_metadata_and_ledger(tmp_path):
+    """models/actions.py family_groups: the base alphabet stacks into
+    the four parameter-shape groups (10 families -> 4 launches), the
+    grouping rides EngineResult -> statespace report -> history-ledger
+    summary, so the BLEST win is attributable per family."""
+    from raft_tla_tpu.models.actions import family_groups
+    from raft_tla_tpu.models.pystate import init_state
+    from raft_tla_tpu.obs import history as history_mod
+    from raft_tla_tpu.obs.report import summarize
+    setup = load_config("configs/MCraft_bounded.cfg")
+    dims = setup.dims
+
+    groups = family_groups(dims)
+    by_name = {g["group"]: g for g in groups}
+    assert set(by_name) == {"server", "server_pair", "server_value",
+                            "slot"}
+    assert by_name["server"]["kernels"] == 4
+    assert by_name["server"]["families"] == ["Restart", "Timeout",
+                                             "BecomeLeader",
+                                             "AdvanceCommitIndex"]
+    assert sum(g["lanes"] for g in groups) == dims.n_instances
+
+    eng = BFSEngine(dims, constraint=build_constraint(dims, setup.bounds),
+                    config=EngineConfig(batch=64, queue_capacity=1 << 12,
+                                        seen_capacity=1 << 14,
+                                        check_deadlock=False,
+                                        max_diameter=2))
+    res = eng.run([init_state(dims)])
+    assert res.family_groups == groups
+    assert res.report.get("family_groups") == groups
+    summ = summarize(res.report)
+    assert summ["family_groups"] == {"server": 4, "server_pair": 2,
+                                     "server_value": 1, "slot": 3}
+
+    ledger = str(tmp_path / "ledger.jsonl")
+    history_mod.append_entry(
+        ledger, history_mod.entry_from_result("check", res,
+                                              label="v4_test"))
+    entry = history_mod.read_history(ledger)[0]
+    assert entry["report"]["family_groups"]["server"] == 4
