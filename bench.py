@@ -62,6 +62,116 @@ def _tpu_tunnel_alive(timeout_s: float = 180.0) -> bool:
         return False
 
 
+def _swarm_bench(setup, platform: str) -> None:
+    """BENCH_MODE=swarm: the randomized-walk tier's bench dialect.
+
+    Same contract as the exhaustive bench — one JSON line on stdout,
+    the run-event log validated as a hard gate, optional BENCH_HISTORY
+    ledger entry — but the headline metric is lockstep walk steps/sec
+    (``value``), with walks/sec, visited/sec and the time-to-first-
+    counterexample (``violation_at_seconds``) riding along.  There is
+    no oracle window: the swarm is not measuring exhaustive coverage,
+    so ``vs_baseline`` has no meaning here (scripts/bench_diff.py
+    folds gracefully when one side of a diff is swarm-dialect).
+    Knobs: BENCH_WALKS / BENCH_MAX_DEPTH / BENCH_RING / BENCH_CHUNK /
+    BENCH_SEED / BENCH_NUM_STEPS (unset = run the BENCH_SECONDS wall
+    budget) on top of the shared BENCH_BATCH / BENCH_PIPELINE /
+    BENCH_SECONDS / BENCH_EVENTS_OUT / BENCH_HISTORY."""
+    import tempfile
+
+    import jax
+
+    from raft_tla_tpu.engine.check import (initial_states,
+                                           resolve_constraint,
+                                           resolve_invariants)
+    from raft_tla_tpu.engine.swarm import SwarmEngine
+
+    walks = int(os.environ.get("BENCH_WALKS", "1024"))
+    max_depth = int(os.environ.get("BENCH_MAX_DEPTH", "64"))
+    ring = int(os.environ.get("BENCH_RING", "16"))
+    chunk = int(os.environ.get("BENCH_CHUNK", "32"))
+    seed = int(os.environ.get("BENCH_SEED", "0"))
+    num_steps = (int(os.environ["BENCH_NUM_STEPS"])
+                 if os.environ.get("BENCH_NUM_STEPS") else None)
+    batch = int(os.environ.get("BENCH_BATCH", str(walks)))
+    events_file = os.environ.get("BENCH_EVENTS_OUT")
+    scratch_dir = None
+    if events_file is None:
+        scratch_dir = tempfile.mkdtemp(prefix="bench_obs_")
+        events_file = os.path.join(scratch_dir, "events.jsonl")
+    eng = SwarmEngine(setup.dims,
+                      invariants=resolve_invariants(setup),
+                      constraint=resolve_constraint(setup),
+                      walks=walks, max_depth=max_depth,
+                      batch=min(batch, walks), chunk=chunk, ring=ring,
+                      pipeline=os.environ.get("BENCH_PIPELINE", "auto"),
+                      events_out=events_file)
+    _mark(f"swarm engine built (walks={walks}, depth={max_depth}, "
+          f"ring={ring}); compiling + running "
+          + (f"{num_steps} steps" if num_steps is not None
+             else f"{BENCH_SECONDS:.0f}s budget"))
+    res = eng.run(initial_states(setup, seed=seed), seed=seed,
+                  num_steps=num_steps,
+                  max_seconds=(None if num_steps is not None
+                               else BENCH_SECONDS))
+    _mark(f"swarm run done: {res.steps} steps / {res.visited} visited "
+          f"in {res.wall_seconds:.1f}s")
+
+    # Same telemetry-regression gate as the exhaustive bench: a swarm
+    # run that leaves its event log missing/malformed fails loudly.
+    from raft_tla_tpu.obs import validate_and_cleanup
+    try:
+        n_events = validate_and_cleanup(events_file, scratch_dir)
+    except (OSError, ValueError) as e:
+        print(f"bench: telemetry regression — run event log invalid: "
+              f"{e}", file=sys.stderr)
+        sys.exit(1)
+    _mark(f"event log validated ({n_events} events)")
+
+    from raft_tla_tpu.obs import host_fingerprint
+    import secrets
+    doc = {
+        "run_id": secrets.token_hex(8),
+        "metric": "swarm_steps_per_sec",
+        "value": round(res.steps_per_second, 1),
+        "unit": "steps/s",
+        "mode": "swarm",
+        "platform": platform,
+        "devices": len(jax.devices()),
+        "host_fingerprint": host_fingerprint(),
+        "walks": res.walks,
+        "steps": res.steps,
+        "visited": res.visited,
+        "traces": res.traces,
+        # Ledger-dialect aliases (entry_from_bench's column names):
+        # distinct = ring-fresh visits, generated = lockstep steps.
+        "distinct_states": res.distinct,
+        "generated_states": res.generated,
+        "generated_per_sec": round(res.steps_per_second, 1),
+        "steps_per_sec": round(res.steps_per_second, 1),
+        "walks_per_sec": round(res.walks_per_second, 1),
+        "visited_per_sec": round(res.states_per_second, 1),
+        "violation_at_seconds": res.violation_at_seconds,
+        "max_depth": max_depth,
+        "ring": ring,
+        "seed": seed,
+        "wall_s": round(res.wall_seconds, 2),
+        "budget_s": BENCH_SECONDS,
+        "diameter": res.diameter,
+        "stop_reason": res.stop_reason,
+        "phases": {k: round(v, 4) for k, v in res.phases.items()},
+        "pipeline": res.pipeline,
+        "report": res.report,
+    }
+    print(json.dumps(doc))
+    history_path = os.environ.get("BENCH_HISTORY")
+    if history_path:
+        from raft_tla_tpu.obs import history as history_mod
+        history_mod.append_entry(
+            history_path, history_mod.entry_from_bench(doc, kind="swarm"))
+        _mark(f"history entry appended to {history_path}")
+
+
 def main():
     # An explicit JAX_PLATFORMS=cpu must actually take effect: the boot
     # hook pins the axon backend by config, so the env var alone is
@@ -96,6 +206,17 @@ def main():
 
     here = os.path.dirname(os.path.abspath(__file__))
     setup = load_config(os.path.join(here, "configs/MCraft_bounded.cfg"))
+    # Second product tier: BENCH_MODE=swarm benches the randomized-walk
+    # engine (engine/swarm.py) on the same pinned model in its own
+    # dialect (_swarm_bench); everything below is the exhaustive
+    # headline measurement.
+    bench_mode = os.environ.get("BENCH_MODE", "exhaustive")
+    if bench_mode == "swarm":
+        return _swarm_bench(setup, platform)
+    if bench_mode != "exhaustive":
+        print(f"bench: unknown BENCH_MODE {bench_mode!r} (expected "
+              f"'exhaustive' or 'swarm')", file=sys.stderr)
+        sys.exit(2)
     # Accelerator capacities are EXPLICIT and modest (~3.5 GB total), not
     # HBM-auto-sized: the only tunnel window ever observed (2026-07-31)
     # wedged during this bench's ~9 GB auto-sized allocation+compile and

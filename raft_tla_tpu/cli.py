@@ -286,6 +286,80 @@ def _client_call(target: str, req: dict, timeout: float) -> dict:
     return json.loads(line)
 
 
+def _run_swarm(args, setup, resolve, batch) -> int:
+    """``check --mode swarm``: the randomized-walk tier
+    (engine/swarm.py).  Same surface contract as the exhaustive
+    branch: a summary line, an optional history-ledger entry
+    (``kind=swarm``), and on a violation the rendered TLC-style
+    counterexample plus exit 1."""
+    from .engine.check import (initial_states, resolve_constraint,
+                               resolve_invariants)
+    from .engine.swarm import SwarmEngine
+
+    walks = int(resolve(args.walks, "WALKS", 1024))
+    ckpt = resolve(args.checkpoint_dir, "CHECKPOINT_DIR", None)
+    engine = SwarmEngine(
+        setup.dims,
+        invariants=resolve_invariants(setup),
+        constraint=resolve_constraint(setup),
+        walks=walks,
+        max_depth=args.max_depth or setup.max_diameter or 128,
+        batch=min(batch, walks),
+        pipeline=resolve(args.pipeline, "PIPELINE", "auto"),
+        events_out=resolve(args.events_out, "EVENTS_OUT", None),
+        checkpoint_dir=ckpt,
+        counterexample_dir=(
+            resolve(args.counterexample_dir, "COUNTEREXAMPLE_DIR", None)
+            or ("." if args.render_trace and not ckpt else None)),
+        progress_seconds=float(
+            resolve(args.progress_interval, "PROGRESS_SECONDS", 5.0)))
+    max_seconds = (args.max_seconds if args.max_seconds is not None
+                   else setup.max_seconds)
+    res = engine.run(initial_states(setup, seed=args.seed),
+                     seed=args.seed, max_seconds=max_seconds)
+    print(f"swarm: {res.walks} walks x depth {engine.max_depth} | "
+          f"{res.steps} steps ({res.steps_per_second:,.0f} steps/s, "
+          f"{res.walks_per_second:,.0f} walks/s) | visited "
+          f"{res.visited} | traces {res.traces} | deepest "
+          f"{res.diameter} | stop: {res.stop_reason} | "
+          f"{res.wall_seconds:.2f}s")
+    if args.metrics_out:
+        _write_metrics(args.metrics_out, engine.metrics)
+    history_path = resolve(args.history, "HISTORY", None)
+    if history_path:
+        from .obs import history as history_mod
+        from .obs.flight import host_fingerprint
+        with open(args.cfg) as f:
+            cfg_text = f.read()
+        history_mod.append_entry(
+            history_path,
+            history_mod.entry_from_result(
+                "swarm", res, cfg_text=cfg_text, dims=setup.dims,
+                host_fingerprint=host_fingerprint(),
+                label=os.path.basename(args.cfg),
+                extra={"swarm": {
+                    "walks": res.walks,
+                    "steps_per_sec": round(res.steps_per_second, 1),
+                    "walks_per_sec": round(res.walks_per_second, 1),
+                    "violation_at_seconds": res.violation_at_seconds}}))
+        print(f"history: entry appended to {history_path}",
+              file=sys.stderr)
+    if res.violation is not None:
+        print()
+        if res.counterexample:
+            with open(res.counterexample["txt"], encoding="utf-8") as f:
+                print(f.read(), end="")
+            print(f"\ncounterexample written: "
+                  f"{res.counterexample['txt']} (+ .json)")
+        else:
+            from .engine import explain as explain_mod
+            print(explain_mod.render_text(
+                engine.replay(res.violation.fingerprint), setup.dims,
+                violation=res.violation), end="")
+        return 1
+    return 0
+
+
 def _run_submit(args) -> int:
     """``submit``: queue a check on a checker service as an async job
     (serving/).  Sends cfg CONTENT (cfg_text), so the service need not
@@ -311,6 +385,9 @@ def _run_submit(args) -> int:
                      ("seed", args.seed or None),
                      ("engine", args.engine),
                      ("pipeline", args.pipeline),
+                     ("mode", getattr(args, "mode", None)),
+                     ("walks", getattr(args, "walks", None)),
+                     ("max_depth", getattr(args, "max_depth", None)),
                      ("num_steps", getattr(args, "num_steps", None)),
                      ("depth", getattr(args, "depth", None))):
         if val is not None:
@@ -589,6 +666,19 @@ def main(argv=None):
     c.add_argument("--seen-capacity", type=int, default=None)
     c.add_argument("--max-diameter", type=int, default=None)
     c.add_argument("--max-seconds", type=float, default=None)
+    c.add_argument("--mode", choices=("exhaustive", "swarm"),
+                   default=None,
+                   help="checking tier: exhaustive BFS (default) or the "
+                        "vmap'd randomized-walk swarm — W deterministic "
+                        "walks per device, per-walk ring dedup, no "
+                        "global seen-set (engine/swarm.py; flag > cfg "
+                        "MODE directive > exhaustive)")
+    c.add_argument("--walks", type=int, default=None,
+                   help="swarm mode: concurrent walks per device (flag "
+                        "> cfg WALKS directive > 1024)")
+    c.add_argument("--max-depth", type=int, default=None,
+                   help="swarm mode: per-trace depth bound before a "
+                        "walk restarts onto a fresh root (default 128)")
     c.add_argument("--no-trace", action="store_true",
                    help="disable counterexample trace recording")
     c.add_argument("--checkpoint-dir", default=None,
@@ -852,8 +942,17 @@ def main(argv=None):
                          "replayed numbered-state trace")
     sb.add_argument("--simulate", action="store_true",
                     help="submit a simulate job instead of a check")
+    sb.add_argument("--mode", choices=("exhaustive", "swarm"),
+                    default=None,
+                    help="check-job tier: exhaustive BFS (default) or "
+                         "the randomized-walk swarm — the cheap "
+                         "high-QPS tier (engine/swarm.py)")
+    sb.add_argument("--walks", type=int, default=None,
+                    help="(swarm jobs) concurrent walks per device")
+    sb.add_argument("--max-depth", type=int, default=None,
+                    help="(swarm jobs) per-trace depth bound")
     sb.add_argument("--num-steps", type=int, default=None,
-                    help="(simulate jobs) total walker-steps")
+                    help="(simulate/swarm jobs) total walker-steps")
     sb.add_argument("--depth", type=int, default=None,
                     help="(simulate jobs) trace depth")
     sb.add_argument("--cache", action="store_true",
@@ -1126,6 +1225,11 @@ def main(argv=None):
         return rc
 
     if args.cmd == "check":
+        mode = resolve(args.mode, "MODE", "exhaustive")
+        if mode not in ("exhaustive", "swarm"):
+            p.error(f"MODE must be exhaustive or swarm, got {mode!r}")
+        if mode == "swarm":
+            return _run_swarm(args, setup, resolve, batch)
         cfgobj = EngineConfig(
             batch=batch,
             queue_capacity=resolve(args.queue_capacity,

@@ -1,0 +1,593 @@
+"""Swarm mode — W deterministic randomized walks per device in lockstep.
+
+The second product tier (ROADMAP item 5): where the exhaustive engines
+prove, the swarm *hunts*.  A swarm run advances W independent walkers
+one action per step through the same BLEST-grouped expand kernels the
+BFS engines use, with three structural differences that remove every
+host round-trip from the hot loop:
+
+- **no global seen-set** — each walk dedups against a fixed-size ring
+  of its own last R accepted fingerprints (ops/walk_kernels.py), so
+  throughput never pays the sorted-FPSet merge or its growth stalls;
+- **counter-based PRNG** — every decision (successor draw, restart
+  root) is a pure hash of ``(seed, walk, step)``, never a split-chain
+  key.  A (seed, walks, depth) run therefore has a bit-identical
+  visited-fingerprint multiset and an identical verdict across runs
+  AND across device batch-size changes (tests/test_swarm.py pins it),
+  and a violating walk is exactly replayable;
+- **per-walk violation latch** — the same (root, action-ring) latch the
+  simulator carries, extended with the global step index so the
+  reported violation is the *globally first* one in (step, walk) order
+  — partition-invariant, not a race between device slices.
+
+Checking semantics match the simulator's TLC ``-simulate`` shape: every
+step evaluates the registered invariants on the chosen successor,
+walks restart on dead ends / pack overflow / constraint stops / ring
+revisits / the depth bound, and a latched violation replays host-side
+through the expand kernel into a full ``[(action, PyState)]`` trace —
+``engine/explain.py`` renders it through the identical
+``write_counterexample`` path as the exhaustive engines (this class
+duck-types ``replay``/``dims``).
+
+Telemetry speaks the swarm dialect of the house schema: ``swarm/steps``
+/ ``swarm/walks`` / ``swarm/visited`` counters, ``swarm_progress`` run
+events (payload object ``swarm``; registered in obs/events.py), a
+statespace report with an embedded ``swarm`` block, and a ``run_end``
+carrying the same ``swarm`` payload — so ``validate_run_events``, the
+history ledger (``kind=swarm``) and the serving layer's job surface
+consume swarm runs unchanged.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import time
+from typing import Callable, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..models.actions import build_expand
+from ..models.dims import RaftDims
+from ..models.invariants import build_inv_id
+from ..models.pystate import PyState
+from ..models.schema import (StateBatch, build_pack_guard, check_packable,
+                             decode_state, encode_state, flatten_state,
+                             stack_states, unflatten_state)
+from ..obs import (MetricsRegistry, RunEventLog, device_memory_stats,
+                   events_path, phase_delta)
+from ..obs.flight import RECORDER as _FLIGHT
+from ..ops.fingerprint import build_fingerprint
+from ..ops.walk_kernels import (CHOICE_STREAM, FAMILY_STREAM, INIT_STREAM,
+                                ROOT_STREAM, family_subset, preferred_choice,
+                                ring_init, ring_probe, ring_push, ring_reset,
+                                walk_bits)
+from .bfs import Violation, _resolve_pipeline
+
+_I32 = jnp.int32
+_U32 = jnp.uint32
+
+
+@dataclasses.dataclass
+class SwarmResult:
+    """Swarm run outcome — swarm-native counters plus the EngineResult
+    surface (stop_reason/distinct/generated/diameter/wall_seconds/
+    pipeline/fused_stages/report/violation/counterexample) the history
+    ledger, serving layer, and explainer already consume.  The ledger
+    dialect: ``distinct`` is accepted (ring-fresh) state visits,
+    ``generated`` is lockstep walk-steps executed."""
+    walks: int = 0
+    steps: int = 0              # lockstep walk-steps executed (W x rounds)
+    visited: int = 0            # accepted state visits (ring-deduped)
+    traces: int = 0             # walks started (W + restarts)
+    distinct: int = 0           # = visited
+    generated: int = 0          # = steps
+    diameter: int = 0           # deepest trace depth any walk reached
+    levels: List[int] = dataclasses.field(default_factory=list)
+    stop_reason: str = "steps"
+    wall_seconds: float = 0.0
+    pipeline: str = ""
+    fused_stages: Dict[str, str] = dataclasses.field(default_factory=dict)
+    phases: Dict[str, float] = dataclasses.field(default_factory=dict)
+    report: Dict = dataclasses.field(default_factory=dict)
+    violation: Optional[Violation] = None
+    violation_trace: Optional[List[Tuple[int, PyState]]] = None
+    #: Wall-clock seconds into the run when the violation latched — the
+    #: swarm's headline "time to first counterexample" metric.
+    violation_at_seconds: Optional[float] = None
+    counterexample: Dict = dataclasses.field(default_factory=dict)
+    #: The visited-fingerprint multiset as an [N, 2] uint32 (hi, lo)
+    #: array, ONLY when the engine was built with
+    #: ``collect_fingerprints=True`` (the determinism tests) — a
+    #: throughput run must not ship every fingerprint to the host.
+    visited_fingerprints: Optional[np.ndarray] = None
+
+    @property
+    def steps_per_second(self) -> float:
+        return self.steps / self.wall_seconds if self.wall_seconds else 0.0
+
+    @property
+    def walks_per_second(self) -> float:
+        return self.traces / self.wall_seconds if self.wall_seconds else 0.0
+
+    @property
+    def states_per_second(self) -> float:
+        return (self.visited / self.wall_seconds
+                if self.wall_seconds else 0.0)
+
+
+def build_swarm_chunk(dims: RaftDims, inv_fns, constraint, D: int, R: int,
+                      chunk: int, pipeline: str = "auto"):
+    """Returns ``chunk_fn(rows, roots, tstep, cur_root, abuf, ring_hi,
+    ring_lo, ring_pos, epoch, walk_ids, seed, k0, k_limit)`` — one
+    jitted scan advancing every lane ``chunk`` lockstep steps from
+    global step ``k0``.  Lane count is taken from ``rows``, so one
+    builder serves the full slices and the remainder slice alike.
+    Steps at or past ``k_limit`` are frozen no-ops (carry unchanged,
+    nothing accepted, nothing latched): the host can run an exact
+    ``num_steps`` budget in chunk-sized dispatches without a remainder
+    recompile.
+
+    The successor draw is **family-diversified** (Holzmann swarm
+    style): each (walk, trace) draws a keep-subset of the model's
+    action families from the ``FAMILY_STREAM`` counter hash keyed on
+    the lane's trace ``epoch`` (restart count), and chooses uniformly
+    among enabled instances of kept families, falling back to all
+    enabled when the subset is empty there.  A uniform instance draw
+    lets the biggest families (raft's 96 message-handling lanes of 132)
+    flood the hunt; the per-trace subset makes each trace a focused
+    walk through a random sub-model — time-to-counterexample on the
+    NoLeaderElected canary drops ~20x.  The mask is a pure function of
+    (seed, walk, epoch), so replayability and partition invariance are
+    untouched."""
+    expand = build_expand(dims)
+    pack_ok = build_pack_guard(dims)
+    inv_id = build_inv_id(inv_fns)
+    fingerprint = build_fingerprint(dims)
+    v2 = _resolve_pipeline(pipeline, dims)
+    fam = jnp.asarray(np.repeat(
+        np.arange(len(dims.family_sizes), dtype=np.int32),
+        dims.family_sizes))
+
+    def chunk_fn(rows, roots, tstep, cur_root, abuf, ring_hi, ring_lo,
+                 ring_pos, epoch, walk_ids, seed, k0, k_limit):
+        B = rows.shape[0]
+        lanes = jnp.arange(B)
+
+        def body(carry, k):
+            (rows, tstep, cur_root, abuf, rh, rl, rp, epoch, restarts,
+             visited, depth_max, latch) = carry
+            act = k < k_limit
+            states = jax.vmap(unflatten_state, (0, None))(rows, dims)
+            if v2 is None:
+                cands, en, ovf = jax.vmap(expand)(states)
+                # uint8-row wrap counts as overflow (simulator rule):
+                # restart rather than step through an aliased row.
+                ovf = ovf | (en & ~jax.vmap(jax.vmap(pack_ok))(cands))
+            else:
+                en, ovf = jax.vmap(v2.masks)(states)  # pack guard folded
+            bits = walk_bits(seed, walk_ids, k, CHOICE_STREAM)
+            mbits = walk_bits(seed, walk_ids, epoch, FAMILY_STREAM)
+            choice = preferred_choice(bits, en, family_subset(mbits, fam))
+            can_step = jnp.any(en, axis=1) & act
+            if v2 is None:
+                nxt = jax.tree.map(lambda a: a[lanes, choice], cands)
+            else:
+                ph = jax.vmap(v2.parent_hash)(states)  # DCE'd: unused
+                _h, _l, nxt = jax.vmap(v2.lane_out)(states, ph,
+                                                    choice.astype(_I32))
+            nrows = jax.vmap(flatten_state, (0, None))(nxt, dims)
+            fp_hi, fp_lo = jax.vmap(fingerprint)(nxt)
+
+            if inv_fns:
+                inv = jax.vmap(inv_id)(nxt)
+            else:
+                inv = jnp.full((B,), -1, _I32)
+            bad = can_step & (inv >= 0)
+            # Latch the slice's FIRST violation: first step with any bad
+            # lane, lowest lane at that step.  The step index rides
+            # along so the host can pick the global (step, walk) minimum
+            # across slices — the partition-invariant verdict.
+            (vf, vinv, vroot, vlen, vacts, vchoice,
+             vwalk, vstep, vhi, vlo) = latch
+            any_new = jnp.any(bad) & ~vf
+            w = jnp.argmax(bad)
+            latch = (vf | jnp.any(bad),
+                     jnp.where(any_new, inv[w], vinv),
+                     jnp.where(any_new, cur_root[w], vroot),
+                     jnp.where(any_new, tstep[w], vlen),
+                     jnp.where(any_new, abuf[w], vacts),
+                     jnp.where(any_new, choice[w].astype(_I32), vchoice),
+                     jnp.where(any_new, walk_ids[w].astype(_I32), vwalk),
+                     jnp.where(any_new, k.astype(_I32), vstep),
+                     jnp.where(any_new, fp_hi[w], vhi),
+                     jnp.where(any_new, fp_lo[w], vlo))
+
+            if constraint is not None:
+                cons_ok = jax.vmap(constraint)(nxt)
+            else:
+                cons_ok = jnp.ones((B,), bool)
+            seen = ring_probe(rh, rl, fp_hi, fp_lo)
+            accept = (can_step & ~jnp.any(ovf, axis=1) & cons_ok & ~seen)
+            # Record the action taken since the last restart (before the
+            # restart decision, mirroring the simulator's abuf contract).
+            abuf = abuf.at[lanes, jnp.clip(tstep, 0, D - 1)].set(
+                jnp.where(can_step, choice.astype(_I32), -1))
+            rh, rl, rp = ring_push(rh, rl, rp, fp_hi, fp_lo, accept)
+            # Restart on: dead end, overflow, constraint stop, ring
+            # revisit (all folded into ~accept) or the depth bound.
+            restart = (~accept | (tstep + 1 >= D)) & act
+            root_idx = (walk_bits(seed, walk_ids, k, ROOT_STREAM)
+                        % _U32(roots.shape[0])).astype(_I32)
+            rows = jnp.where(restart[:, None], roots[root_idx],
+                             jnp.where(accept[:, None], nrows, rows))
+            cur_root = jnp.where(restart, root_idx, cur_root)
+            rh, rl, rp = ring_reset(rh, rl, rp, restart)
+            depth_max = jnp.maximum(
+                depth_max, jnp.max(jnp.where(accept, tstep + 1, 0)))
+            tstep = jnp.where(restart, 0,
+                              jnp.where(accept, tstep + 1, tstep))
+            # A restart begins the walk's next trace: bump its epoch so
+            # the FAMILY_STREAM mask re-draws — every trace hunts a
+            # fresh random sub-model.
+            epoch = epoch + restart.astype(_I32)
+            restarts = restarts + jnp.sum(restart, dtype=_I32)
+            visited = visited + jnp.sum(accept, dtype=_I32)
+            return (rows, tstep, cur_root, abuf, rh, rl, rp, epoch,
+                    restarts, visited, depth_max, latch), \
+                (fp_hi, fp_lo, accept)
+
+        latch0 = (jnp.bool_(False), jnp.int32(-1), jnp.int32(0),
+                  jnp.int32(0), jnp.zeros((D,), _I32), jnp.int32(-1),
+                  jnp.int32(-1), jnp.int32(-1), _U32(0), _U32(0))
+        carry0 = (rows, tstep, cur_root, abuf, ring_hi, ring_lo, ring_pos,
+                  epoch, jnp.int32(0), jnp.int32(0), jnp.int32(0), latch0)
+        ks = k0 + jnp.arange(chunk, dtype=_I32)
+        return jax.lax.scan(body, carry0, ks)
+
+    return chunk_fn
+
+
+class SwarmEngine:
+    """W lockstep randomized walks; see the module docstring.
+
+    ``batch`` caps lanes per device dispatch (walks are sliced across
+    dispatches; slicing never changes any walk's trajectory).  ``ring``
+    is the per-walk dedup capacity R.  ``chunk`` is scan steps per
+    dispatch — it bounds how far past a violation the run computes, but
+    neither the verdict nor an exact ``num_steps`` multiset depends on
+    it."""
+
+    def __init__(self, dims: RaftDims,
+                 invariants: Optional[Dict[str, Callable]] = None,
+                 constraint: Optional[Callable] = None, *,
+                 walks: int = 1024, max_depth: int = 128,
+                 batch: Optional[int] = None, chunk: int = 32,
+                 ring: int = 16, pipeline: str = "auto", metrics=None,
+                 events_out: Optional[str] = None,
+                 checkpoint_dir: Optional[str] = None,
+                 postmortem_dir: Optional[str] = None,
+                 counterexample_dir: Optional[str] = None,
+                 collect_fingerprints: bool = False,
+                 progress_seconds: float = 5.0,
+                 run_context_extra: Optional[dict] = None):
+        if walks < 1:
+            raise ValueError(f"walks must be >= 1, got {walks}")
+        if max_depth < 1:
+            raise ValueError(f"max_depth must be >= 1, got {max_depth}")
+        self.dims = dims
+        self.metrics = metrics or MetricsRegistry()
+        self.inv_names = list((invariants or {}).keys())
+        inv_fns = list((invariants or {}).values())
+        self.walks, self.max_depth, self.ring = walks, max_depth, ring
+        self.batch = min(batch or walks, walks)
+        self.chunk = chunk
+        self.events_out = events_out
+        self.checkpoint_dir = checkpoint_dir
+        self.postmortem_dir = postmortem_dir
+        self.counterexample_dir = counterexample_dir
+        self.collect_fingerprints = collect_fingerprints
+        self.progress_seconds = progress_seconds
+        self.run_context_extra = run_context_extra
+        self.pipeline_name = ("v2" if _resolve_pipeline(pipeline, dims)
+                              is not None else "v1")
+        inv_id = build_inv_id(inv_fns)
+        self._chunk = jax.jit(build_swarm_chunk(
+            dims, inv_fns, constraint, max_depth, ring, chunk,
+            pipeline=pipeline))
+
+        def roots_inv(batch):
+            # Unpacked int32 StateBatch (simulator rule): uint8 packing
+            # wraps out-of-range roots, masking a root TypeOK violation.
+            if inv_fns:
+                return jax.vmap(inv_id)(batch)
+            return jnp.full(batch.term.shape[:1], -1, _I32)
+
+        self._roots_inv = jax.jit(roots_inv)
+        self._expand1 = jax.jit(build_expand(dims))
+        self._fp1 = jax.jit(build_fingerprint(dims))
+        self._last_trace: Optional[List[Tuple[int, PyState]]] = None
+
+    # -- explain.py duck-type surface ----------------------------------
+    def replay(self, fp: int) -> List[Tuple[int, PyState]]:
+        """The explainer contract (engine/bfs.py replay): the traced
+        violation's full ``[(action_id, PyState)]`` path root-first.
+        The swarm reconstructs its single latched trace at violation
+        time; only that fingerprint is replayable."""
+        if self._last_trace is None:
+            raise KeyError(f"no traced violation to replay ({fp:#x})")
+        return list(self._last_trace)
+
+    def _postmortem_path(self):
+        d = self.postmortem_dir or self.checkpoint_dir
+        return os.path.join(d, "postmortem.json") if d else None
+
+    # -- run -----------------------------------------------------------
+    def run(self, roots: List[PyState], *, seed: int = 0,
+            num_steps: Optional[int] = None,
+            max_seconds: Optional[float] = None) -> SwarmResult:
+        """Run the swarm: every walk advances in lockstep until the
+        first latched violation, the ``max_seconds`` budget, or
+        ``num_steps`` steps per walk (default ``max_depth`` when no
+        time budget is given — one depth-budget's worth of steps)."""
+        res = SwarmResult(walks=self.walks, pipeline=self.pipeline_name)
+        mt = self.metrics
+        if num_steps is None and max_seconds is None:
+            num_steps = self.max_depth
+        t0 = time.time()
+        evlog = RunEventLog(events_path(self.events_out,
+                                        self.checkpoint_dir))
+        phase_base = mt.phase_seconds()
+        _FLIGHT.arm(self._postmortem_path(), metrics=mt, context={
+            "engine": type(self).__name__, "mode": "swarm",
+            "dims": repr(self.dims), "walks": self.walks,
+            "max_depth": self.max_depth, "batch": self.batch,
+            "ring": self.ring, "pipeline": self.pipeline_name,
+            **dict(self.run_context_extra or {})})
+        _FLIGHT.set_live_evlog(evlog)
+        evlog.emit("run_start", engine=type(self).__name__, mode="swarm",
+                   dims=repr(self.dims), walks=self.walks,
+                   max_depth=self.max_depth, batch=self.batch,
+                   ring=self.ring, seed=seed, num_steps=num_steps,
+                   memory=device_memory_stats())
+        err = None
+        try:
+            self._run_impl(roots, res, seed, num_steps, max_seconds,
+                           evlog, t0)
+            return res
+        except BaseException as e:
+            err = e
+            raise
+        finally:
+            res.wall_seconds = time.time() - t0
+            res.distinct, res.generated = res.visited, res.steps
+            res.phases = phase_delta(mt.phase_seconds(), phase_base)
+            ce_path = None
+            ce_dir = self.counterexample_dir or self.checkpoint_dir
+            if err is None and res.violation is not None and ce_dir:
+                try:
+                    from .explain import write_counterexample
+                    res.counterexample = write_counterexample(
+                        self, res, ce_dir)
+                    ce_path = res.counterexample["txt"]
+                except Exception as e:
+                    import sys as _sys
+                    print(f"counterexample render failed: "
+                          f"{type(e).__name__}: {e}", file=_sys.stderr)
+            swarm_block = self._swarm_block(res)
+            if err is None:
+                res.report = {
+                    "collision": {"calculated": 0.0},
+                    "diameter": res.diameter,
+                    "verdict": ("violation" if res.violation is not None
+                                else "ok"),
+                    "levels": [],
+                    "mode": "swarm",
+                    "swarm": swarm_block,
+                }
+                evlog.emit("statespace", report=res.report)
+            pm_path = None
+            if err is not None:
+                pm_path = _FLIGHT.dump(
+                    f"swarm run error: {type(err).__name__}: {err}")
+            evlog.emit(
+                "run_end",
+                stop_reason=(res.stop_reason if err is None else "error"),
+                error=(f"{type(err).__name__}: {err}"
+                       if err is not None else None),
+                postmortem_path=pm_path,
+                counterexample_path=ce_path,
+                distinct=res.distinct, generated=res.generated,
+                diameter=res.diameter, levels=[],
+                wall_seconds=res.wall_seconds,
+                phase_seconds=res.phases, swarm=swarm_block,
+                memory=device_memory_stats())
+            _FLIGHT.set_live_evlog(None)
+            _FLIGHT.disarm()
+            evlog.close()
+
+    def _swarm_block(self, res: SwarmResult) -> dict:
+        """The ``swarm`` payload object shared by ``swarm_progress``,
+        ``run_end``, and the statespace report."""
+        return {"walks": res.walks, "steps": res.steps,
+                "visited": res.visited, "traces": res.traces,
+                "max_depth": self.max_depth, "ring": self.ring,
+                "steps_per_sec": round(res.steps_per_second, 1),
+                "walks_per_sec": round(res.walks_per_second, 1),
+                "visited_per_sec": round(res.states_per_second, 1),
+                "violation_at_seconds": res.violation_at_seconds}
+
+    def _prepare_roots(self, roots: List[PyState], res: SwarmResult):
+        """TLC checks invariants on initial states too: a violating
+        root ends the run immediately with a length-1 trace."""
+        dims = self.dims
+        encoded = [encode_state(s, dims) for s in roots]
+        rinv = np.asarray(self._roots_inv(stack_states(encoded)))
+        if (rinv >= 0).any():
+            idx = int(np.argmax(rinv >= 0))
+            hi, lo = self._fp1(encoded[idx])
+            fp = (int(hi) << 32) | int(lo)
+            res.violation = Violation(
+                invariant=self.inv_names[int(rinv[idx])],
+                state=roots[idx], fingerprint=fp)
+            res.violation_trace = [(-1, roots[idx])]
+            self._last_trace = res.violation_trace
+            res.stop_reason = "violation"
+            res.violation_at_seconds = 0.0
+            return None
+        for e in encoded:
+            check_packable(e, self.dims)
+        return np.stack([flatten_state(e, dims) for e in encoded])
+
+    def _run_impl(self, roots, res, seed, num_steps, max_seconds,
+                  evlog, t0):
+        W, D, B = self.walks, self.max_depth, self.batch
+        mt = self.metrics
+        roots_np = self._prepare_roots(roots, res)
+        if roots_np is None:
+            return
+        dev = jax.devices()[0]
+        roots_j = jax.device_put(jnp.asarray(roots_np), dev)
+        n_roots = roots_np.shape[0]
+        k_limit = jnp.int32(num_steps if num_steps is not None
+                            else np.iinfo(np.int32).max)
+        seed_j = _U32(np.uint32(seed & 0xFFFFFFFF))
+
+        # Walk slices: global walk ids 0..W-1 in ``batch``-lane device
+        # dispatches.  Everything per-walk depends only on (seed,
+        # walk_id, step), so the slicing is invisible to the walks.
+        slices = []
+        for off in range(0, W, B):
+            ids = np.arange(off, min(off + B, W), dtype=np.int32)
+            lanes = len(ids)
+            walk_ids = jax.device_put(jnp.asarray(ids), dev)
+            root0 = (np.asarray(walk_bits(seed_j, walk_ids, 0,
+                                          INIT_STREAM))
+                     % n_roots).astype(np.int32)
+            rh, rl, rp = ring_init(lanes, self.ring)
+            slices.append({
+                "walk_ids": walk_ids,
+                "rows": jax.device_put(roots_j[jnp.asarray(root0)], dev),
+                "tstep": jax.device_put(jnp.zeros((lanes,), _I32), dev),
+                "cur_root": jax.device_put(jnp.asarray(root0), dev),
+                "abuf": jax.device_put(jnp.zeros((lanes, D), _I32), dev),
+                "ring_hi": jax.device_put(rh, dev),
+                "ring_lo": jax.device_put(rl, dev),
+                "ring_pos": jax.device_put(rp, dev),
+                "epoch": jax.device_put(jnp.zeros((lanes,), _I32), dev),
+                "visited": 0, "latch": None, "ys": None,
+            })
+        res.traces = W
+        mt.counter("swarm/walks", W)
+        mt.gauge("swarm/active_walks", W)
+
+        fps_acc: List[np.ndarray] = []
+        k0 = 0
+        depth_max = 0
+        last_progress = t0
+        while True:
+            with mt.phase_timer("swarm_chunk"):
+                for s in slices:
+                    carry, ys = self._chunk(
+                        s["rows"], roots_j, s["tstep"], s["cur_root"],
+                        s["abuf"], s["ring_hi"], s["ring_lo"],
+                        s["ring_pos"], s["epoch"], s["walk_ids"], seed_j,
+                        jnp.int32(k0), k_limit)
+                    (s["rows"], s["tstep"], s["cur_root"], s["abuf"],
+                     s["ring_hi"], s["ring_lo"], s["ring_pos"],
+                     s["epoch"], s["restarts"], s["visited_d"],
+                     s["depth_d"], s["latch"]) = carry
+                    s["ys"] = ys
+            stepped = min(self.chunk,
+                          max(0, int(k_limit) - k0)) if num_steps \
+                else self.chunk
+            k0 += self.chunk
+            res.steps += W * stepped
+            fired = []
+            with mt.phase_timer("swarm_fetch"):
+                for s in slices:
+                    res.traces += int(s["restarts"])
+                    mt.counter("swarm/walks", int(s["restarts"]))
+                    v = int(s["visited_d"])
+                    res.visited += v
+                    mt.counter("swarm/visited", v)
+                    depth_max = max(depth_max, int(s["depth_d"]))
+                    vf = bool(s["latch"][0])
+                    if vf:
+                        fired.append(s["latch"])
+                    if self.collect_fingerprints:
+                        hi, lo, acc = (np.asarray(a) for a in s["ys"])
+                        m = acc.reshape(-1)
+                        fps_acc.append(np.stack(
+                            [hi.reshape(-1)[m], lo.reshape(-1)[m]],
+                            axis=1))
+            mt.counter("swarm/steps", W * stepped)
+            res.diameter = depth_max
+            now = time.time()
+            if (k0 == self.chunk
+                    or now - last_progress >= self.progress_seconds):
+                last_progress = now
+                res.wall_seconds = now - t0
+                evlog.emit("swarm_progress", depth=k0,
+                           swarm=self._swarm_block(res))
+                _FLIGHT.progress(mode="swarm", steps=res.steps,
+                                 visited=res.visited, traces=res.traces)
+            if fired:
+                # Globally first violation in (step, walk) order — the
+                # partition-invariant pick across slices.
+                latch = min(fired, key=lambda lt: (int(lt[7]),
+                                                   int(lt[6])))
+                self._reconstruct(res, roots, latch)
+                res.stop_reason = "violation"
+                res.violation_at_seconds = round(time.time() - t0, 6)
+                evlog.emit("violation",
+                           invariant=(res.violation.invariant
+                                      if res.violation else "?"),
+                           fingerprint=(hex(res.violation.fingerprint)
+                                        if res.violation else None),
+                           walk=int(latch[6]), step=int(latch[7]),
+                           at_seconds=res.violation_at_seconds)
+                break
+            if max_seconds is not None and time.time() - t0 > max_seconds:
+                res.stop_reason = "max_seconds"
+                break
+            if num_steps is not None and k0 >= num_steps:
+                res.stop_reason = "steps"
+                break
+        if self.collect_fingerprints:
+            res.visited_fingerprints = (
+                np.concatenate(fps_acc, axis=0) if fps_acc
+                else np.zeros((0, 2), np.uint32))
+
+    def _reconstruct(self, res: SwarmResult, roots, latch):
+        """Replay the latched (root, action sequence) through the expand
+        kernel — the simulator's reconstruction, including its
+        slot-aliasing rule: thread the ENCODED candidate row, never
+        re-encode the decoded state (re-encoding reassigns message
+        slots and slot-indexed action ids would then address the wrong
+        message mid-replay)."""
+        (_vf, vinv, vroot, vlen, vacts, vchoice, _vwalk, _vstep,
+         vhi, vlo) = latch
+        vinv, vroot, vlen = int(vinv), int(vroot), int(vlen)
+        vacts = np.asarray(vacts)
+        state = roots[vroot]
+        st = encode_state(state, self.dims)
+        trace = [(-1, state)]
+        for g in list(vacts[:vlen]) + [int(vchoice)]:
+            g = int(g)
+            cands, en, _ovf = self._expand1(st)
+            if g < 0 or not bool(np.asarray(en)[g]):
+                break
+            row = jax.tree.map(lambda a: np.asarray(a)[g], cands)
+            st = StateBatch(*row)
+            state = decode_state(st, self.dims)
+            trace.append((g, state))
+        fp = (int(vhi) << 32) | int(vlo)
+        res.violation = Violation(
+            invariant=(self.inv_names[vinv]
+                       if 0 <= vinv < len(self.inv_names) else "?"),
+            state=state, fingerprint=fp)
+        res.violation_trace = trace
+        self._last_trace = trace

@@ -59,13 +59,19 @@ KNOWN_EVENTS = (
     # (parallel/mesh.py skew telemetry).
     "perf",             # launch/roofline/advisor block; payload: "perf"
     "skew",             # shard imbalance warning; payload: "balance"
+    # Swarm tier (engine/swarm.py): periodic walker progress.  Swarm
+    # runs also attach the same ``swarm`` payload object to their
+    # ``run_end`` (exhaustive run_ends carry none, so only the
+    # progress event gets schema-table enforcement).
+    "swarm_progress",   # walker-fleet progress; payload: "swarm"
 )
 
 #: Structured payload field each new event type must carry.
 _EVENT_PAYLOAD_FIELDS = {"chunk_profile": "stages", "coverage": "actions",
                          "postmortem": "dump", "watch_attach": "client",
                          "xla_profile": "capture", "statespace": "report",
-                         "perf": "perf", "skew": "balance"}
+                         "perf": "perf", "skew": "balance",
+                         "swarm_progress": "swarm"}
 
 
 #: memory_stats() keys kept in event payloads (one extraction for the

@@ -290,6 +290,20 @@ def render_table(entries: List[dict], perf: bool = False) -> str:
                 f"{key or 'unknown'}) — rates before/after are not "
                 f"comparable")
         rate = e.get("distinct_per_sec")
+        # Swarm-dialect rows (kind=swarm, from check --mode swarm or
+        # BENCH_MODE=swarm): the rate column carries the tier's steps/s
+        # headline, flagged as such — a walker's rate sitting in an
+        # exhaustive distinct/s trajectory must read as a different
+        # dialect, not as a host anomaly or a throughput jump.  These
+        # rows carry a real host_fingerprint, so the host?/HOST-CHANGE
+        # flags stay what they mean.
+        sw = e.get("swarm")
+        if sw is None and isinstance(e.get("bench"), dict) \
+                and e["bench"].get("mode") == "swarm":
+            sw = e["bench"]
+        if isinstance(sw, dict):
+            rate = sw.get("steps_per_sec", rate)
+            flags.append("steps/s")
         d, dia = e.get("distinct"), e.get("diameter")
         row = (f"{i:3d} {str(e.get('label') or '-'):20s} "
                f"{str(e.get('kind') or '-'):9s} {str(key or '?'):10s} "

@@ -26,6 +26,19 @@ response per line.  Requests:
                                  "trace": [{"action": "...",
                                             "state": "..."}, ...]},
             "deadlock": null | "<state>", "wall_seconds": S}
+    {"op": "check", "cfg": ..., "mode": "swarm", "walks": 1024,
+     "max_depth": 64, "num_steps": N, "seed": 0, "max_seconds": S}
+        -> {"ok": true, "mode": "swarm", "walks": W, "steps": N,
+            "visited": N, "traces": N, "diameter": N,
+            "steps_per_second": R, "walks_per_second": R,
+            "violation_at_seconds": S | null, "stop_reason": "...",
+            "violation": null | {...}, "report": {...}}
+       The swarm tier (engine/swarm.py): W deterministic randomized
+       walks instead of exhaustive BFS — the cheap high-QPS job class.
+       Mode resolves request field > cfg "\\* TPU: MODE" directive >
+       exhaustive; an unknown mode answers {"ok": false} and counts
+       server/rejected/bad_mode (submit validates it at admission, so
+       it can never surface as an executor-thread failure).
     {"op": "simulate", "cfg": ..., "num_steps": N, "depth": D,
      "batch": B, "seed": 0, "max_seconds": S}
         -> {"ok": true, "steps": N, "traces": N, "wall_seconds": S,
@@ -138,6 +151,7 @@ _CACHE_CAP = 8
 from collections import OrderedDict  # noqa: E402
 _ENGINES: "OrderedDict" = OrderedDict()   # (cfg identity, opts) -> engine
 _SIMS: "OrderedDict" = OrderedDict()      # ditto for simulators
+_SWARMS: "OrderedDict" = OrderedDict()    # ditto for swarm engines
 # NOTE the run-history ledger path (--history) is deliberately NOT a
 # module global: several servers can live in one process (tests do),
 # and a global would split-brain their ledgers.  It rides per-request
@@ -237,6 +251,21 @@ def _do_check(req, telemetry=None):
     from .engine.check import engine_config_from_backend
 
     setup, ident, cfg_text = _load_setup(req)
+    # Engine-tier routing (request "mode" field > cfg "\* TPU: MODE"
+    # directive > exhaustive — the standard precedence): swarm-mode
+    # checks run the randomized-walk tier (engine/swarm.py) through
+    # the same request/telemetry/ledger surface.  Unknown modes reject
+    # cleanly here; submit requests are additionally validated at
+    # admission (_do_submit) so a bad mode never reaches the executor
+    # thread.
+    mode = req.get("mode") or setup.backend.get("MODE") or "exhaustive"
+    if mode == "swarm":
+        return _do_swarm(req, telemetry,
+                         _loaded=(setup, ident, cfg_text))
+    if mode != "exhaustive":
+        _METRICS.counter("server/rejected/bad_mode")
+        raise ValueError(f"unknown mode {mode!r} (expected "
+                         f"'exhaustive' or 'swarm')")
     record_trace = bool(req.get("trace", False))
     # Precedence everywhere (utils/cfg.py): request field > cfg "\* TPU:"
     # backend directive > built-in default — the backend-seeded config is
@@ -379,6 +408,100 @@ def _do_check(req, telemetry=None):
     return out
 
 
+def _do_swarm(req, telemetry=None, _loaded=None):
+    """Run one swarm-mode check request — the cheap high-QPS tier
+    (engine/swarm.py), reached via ``_do_check``'s mode routing.  Same
+    warm-cache + per-request contract as ``_do_check``: the compiled
+    engine is LRU-cached on the program-shaping knobs (walks, depth,
+    batch, pipeline key the cache; seed and the step/wall budgets are
+    per-request run() arguments), and the job executor's scoped
+    ``events_out`` / ``postmortem_dir`` / ``run_context`` are
+    (re)assigned on EVERY request so a cached engine never leaks one
+    job's paths into the next."""
+    from .engine.check import (initial_states, resolve_constraint,
+                               resolve_invariants)
+    from .engine.swarm import SwarmEngine
+
+    setup, ident, cfg_text = (_loaded if _loaded is not None
+                              else _load_setup(req))
+    backend = setup.backend
+    walks = (int(req["walks"]) if req.get("walks") is not None
+             else int(backend.get("WALKS", 1024)))
+    max_depth = (int(req["max_depth"])
+                 if req.get("max_depth") is not None
+                 else int(setup.max_diameter or 128))
+    batch = (int(req["batch"]) if req.get("batch") is not None
+             else int(backend.get("BATCH", walks)))
+    pipeline = (req["pipeline"] if req.get("pipeline") is not None
+                else backend.get("PIPELINE", "auto"))
+    key = (ident, "swarm", walks, max_depth, min(batch, walks), pipeline)
+    eng = _cache_get(_SWARMS, key, "swarm_cache")
+    if eng is None:
+        eng = SwarmEngine(setup.dims,
+                          invariants=resolve_invariants(setup),
+                          constraint=resolve_constraint(setup),
+                          walks=walks, max_depth=max_depth,
+                          batch=min(batch, walks), pipeline=pipeline,
+                          metrics=_METRICS)
+        _cache_put(_SWARMS, key, eng, "swarm_cache")
+    tel = telemetry or {}
+    eng.events_out = tel.get("events_out")
+    eng.postmortem_dir = tel.get("postmortem_dir")
+    eng.run_context_extra = tel.get("run_context")
+    seed = int(req.get("seed", 0))
+    res = eng.run(initial_states(setup, seed=seed), seed=seed,
+                  num_steps=(int(req["num_steps"])
+                             if req.get("num_steps") is not None
+                             else None),
+                  max_seconds=(req.get("max_seconds")
+                               if req.get("max_seconds") is not None
+                               else setup.max_seconds))
+    history_path = tel.get("history")
+    if history_path:
+        # Two ledger legs per served swarm run: kind=swarm (the tier's
+        # own dialect, with the swarm rate block) AND the kind=server
+        # serving leg every server-executed check lands — one run,
+        # both ledger surfaces.  Bookkeeping only: a ledger write
+        # failure must not fail the response.
+        try:
+            from .obs import history as history_mod
+            from .obs.flight import host_fingerprint
+            ctx = tel.get("run_context") or {}
+            hfp = host_fingerprint()
+            for kind, extra in (
+                    ("swarm", {"swarm": res.report.get("swarm")}),
+                    ("server", {"job_id": ctx.get("job_id"),
+                                "tenant": ctx.get("tenant"),
+                                "mode": "swarm"})):
+                history_mod.append_entry(
+                    history_path,
+                    history_mod.entry_from_result(
+                        kind, res, cfg_text=cfg_text, dims=setup.dims,
+                        host_fingerprint=hfp, label=_cfg_label(req),
+                        extra=extra))
+        except Exception as e:
+            import sys as _sys
+            print(f"server history append failed: "
+                  f"{type(e).__name__}: {e}", file=_sys.stderr)
+    out = {"ok": True, "mode": "swarm", "walks": res.walks,
+           "steps": res.steps, "visited": res.visited,
+           "traces": res.traces, "distinct": res.distinct,
+           "generated": res.generated, "diameter": res.diameter,
+           "stop_reason": res.stop_reason,
+           "wall_seconds": round(res.wall_seconds, 3),
+           "steps_per_second": round(res.steps_per_second, 1),
+           "walks_per_second": round(res.walks_per_second, 1),
+           "violation_at_seconds": res.violation_at_seconds,
+           "pipeline": res.pipeline,
+           "phases": {k: round(v, 4) for k, v in res.phases.items()},
+           "report": dict(res.report),
+           "violation": None}
+    if res.violation is not None:
+        out["violation"] = _violation_json(eng, res.violation,
+                                           setup.dims)
+    return out
+
+
 def _do_simulate(req):
     from .engine.check import resolve_constraint, resolve_invariants
     from .engine.simulate import Simulator
@@ -437,7 +560,9 @@ def _do_stats() -> dict:
             "metrics": _METRICS.snapshot(),
             "engine_cache": {"size": len(_ENGINES),
                              "capacity": _CACHE_CAP},
-            "sim_cache": {"size": len(_SIMS), "capacity": _CACHE_CAP}}
+            "sim_cache": {"size": len(_SIMS), "capacity": _CACHE_CAP},
+            "swarm_cache": {"size": len(_SWARMS),
+                            "capacity": _CACHE_CAP}}
 
 
 def _execute_job(request: dict, job: dict,
@@ -497,6 +622,14 @@ def _do_submit(req: dict, manager) -> dict:
             or inner.get("op") not in ("check", "simulate"):
         raise ValueError("submit needs a 'job' object whose op is "
                          "'check' or 'simulate'")
+    # Validate the engine-tier selector at ADMISSION, not execution:
+    # an unknown mode must answer THIS submit with a clean
+    # {"ok": false}, never queue and then surface as an
+    # executor-thread exception hours later.
+    if inner.get("mode") not in (None, "exhaustive", "swarm"):
+        _METRICS.counter("server/rejected/bad_mode")
+        raise ValueError(f"unknown mode {inner.get('mode')!r} "
+                         f"(expected 'exhaustive' or 'swarm')")
     label = _cfg_label(inner)
     if req.get("cache") and inner.get("cfg"):
         # Pin the cfg CONTENT at submit time: the cache key is

@@ -47,7 +47,9 @@ lands, engine/explain.py; defaults next to CHECKPOINT_DIR), HISTORY
 (append one run-history ledger entry per run to this JSONL file,
 obs/history.py), PERF (the performance observatory: launch accounting,
 static roofline + fusion advisor, obs/perf.py — observational, implies
-sparse chunk profiling).
+sparse chunk profiling), MODE (checking engine tier: ``exhaustive``
+(default) or ``swarm`` — the vmap'd randomized-walk engine,
+engine/swarm.py), WALKS (swarm mode: concurrent walks per device).
 Precedence everywhere: CLI flag > cfg backend key > built-in default.
 """
 
@@ -98,7 +100,7 @@ _BACKEND_KEYS = {
     "SPILL_DIR", "TRACE_DIR", "PROGRESS_SECONDS", "EVENTS_OUT",
     "KEEP_CHECKPOINTS", "TRACE_OUT", "PROFILE_CHUNKS", "POR", "POR_TABLE",
     "PIPELINE", "XLA_PROFILE", "METRICS_PORT", "REPORT",
-    "COUNTEREXAMPLE_DIR", "HISTORY", "PERF",
+    "COUNTEREXAMPLE_DIR", "HISTORY", "PERF", "MODE", "WALKS",
 }
 
 

@@ -60,7 +60,7 @@ def _host_fingerprint() -> str:
     return hashlib.sha256("|".join(parts).encode()).hexdigest()[:12]
 
 
-def enable_persistent_cache() -> None:
+def enable_persistent_cache(tag: str = "") -> None:
     """Point jax at the repo-local persistent compilation cache.  The BFS
     chunk program takes ~1 min (TPU) to minutes (CPU) to compile; with the
     cache, every CLI/bench/driver invocation after the first is instant.
@@ -79,12 +79,26 @@ def enable_persistent_cache() -> None:
     cache entry was written by THIS host in THIS session (verified
     2026-07-31: fresh per-host dir, same process lineage).  It is a
     false positive for the SIGILL hazard; a real cross-host entry can no
-    longer be loaded at all under the fingerprinted directory."""
+    longer be loaded at all under the fingerprinted directory.
+
+    ``tag`` further namespaces the directory by *execution context* on
+    the same host.  The unit suite runs on 8 virtual CPU devices
+    (conftest's ``--xla_force_host_platform_device_count=8``) while
+    every CLI/bench/server invocation runs on 1; letting both contexts
+    interleave entries in one directory changes the suite's
+    compile-vs-load history run to run, and jaxlib's CPU client is
+    heap-layout fragile enough under the big mesh tests that a
+    foreign-context cache state reproduces both a lowering-time abort
+    and a wrong-resume ``seen-set probe failure`` (observed 2026-08-06:
+    ``test_mesh`` green with a suite-pure cache, aborted with a
+    bench-populated one).  A tagged caller gets its own subdirectory,
+    so cross-context interleaving is structurally impossible."""
     import jax
 
     cache = os.path.join(
         os.path.dirname(os.path.dirname(os.path.dirname(
-            os.path.abspath(__file__)))), ".jax_cache", _host_fingerprint())
+            os.path.abspath(__file__)))), ".jax_cache",
+        _host_fingerprint() + ("-" + tag if tag else ""))
     try:
         jax.config.update("jax_compilation_cache_dir", cache)
         jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)

@@ -53,6 +53,13 @@ BENCH_r01–r05 files predate chunk_stages/coverage and still diff):
   number — identical models must produce identical mixes up to
   duration-budget truncation — so it defaults loose (5 pts).
 
+- swarm dialect (``BENCH_MODE=swarm`` documents, ``mode: "swarm"``):
+  when BOTH sides are swarm, the steps/s headline plus walks/s,
+  visited/s, and the time-to-first-counterexample are gated; when the
+  two sides speak DIFFERENT dialects, the diff folds to a note with
+  both headlines reported and nothing gated — an exhaustive distinct/s
+  number and a swarm steps/s number measure different things.
+
 Additionally, when both runs embed a ``host_fingerprint`` (bench.py,
 BENCH_r06+), mismatched hardware/stack identity prints a loud
 cross-host WARNING note — absolute rates measured on different hosts
@@ -177,6 +184,47 @@ def diff_headline(old: dict, new: dict, d: Diff, max_regress: float):
             d.regress(f"{label} moved {pct:+.1f}% "
                       f"(> {max_regress:.0%} allowed): {ov:,.1f} -> "
                       f"{nv:,.1f}")
+
+
+def bench_mode(doc: dict) -> str:
+    """Which bench dialect a document speaks: ``swarm`` (bench.py
+    BENCH_MODE=swarm — steps/s headline, walks/visited rates,
+    violation_at_seconds) or ``exhaustive`` (the classic distinct/s
+    headline; legacy files predate the key)."""
+    return doc.get("mode", "exhaustive")
+
+
+def diff_swarm(old: dict, new: dict, d: Diff, max_regress: float):
+    """Swarm-dialect axes (both sides BENCH_MODE=swarm): the walk and
+    visit rates regress like the headline, and the time-to-first-
+    counterexample regresses when the candidate finds its violation
+    slower than allowed — or stops finding one the baseline found."""
+    for key, label in (("walks_per_sec", "walks/s"),
+                       ("visited_per_sec", "visited states/s")):
+        ov, nv = old.get(key), new.get(key)
+        if ov is None or nv is None:
+            continue
+        pct = (nv - ov) / ov * 100.0 if ov else 0.0
+        d.note(f"swarm {label}: {ov:,.1f} -> {nv:,.1f} ({pct:+.1f}%)")
+        if _ratio_regress(ov, nv, max_regress):
+            d.regress(f"swarm {label} moved {pct:+.1f}% "
+                      f"(> {max_regress:.0%} allowed): {ov:,.1f} -> "
+                      f"{nv:,.1f}")
+    ov, nv = old.get("violation_at_seconds"), new.get("violation_at_seconds")
+    if ov is None and nv is None:
+        return
+    d.note(f"violation found at: "
+           f"{'-' if ov is None else f'{ov:.2f}s'} -> "
+           f"{'-' if nv is None else f'{nv:.2f}s'}")
+    if ov is not None and nv is None:
+        d.regress(f"baseline found its violation at {ov:.2f}s; the "
+                  f"candidate found none in its budget")
+    elif ov is not None and nv is not None \
+            and ov > 0 and nv > ov * (1.0 + max_regress):
+        d.regress(f"time-to-violation rose "
+                  f"{(nv - ov) / ov * 100.0:.1f}% "
+                  f"(> {max_regress:.0%} allowed): {ov:.2f}s -> "
+                  f"{nv:.2f}s")
 
 
 def diff_phases(old: dict, new: dict, d: Diff, max_regress: float,
@@ -489,8 +537,31 @@ def main(argv=None) -> int:
               f"{args.history} ({old_label})")
     d = Diff()
     diff_host(old, new, d)
+    om, nm = bench_mode(old), bench_mode(new)
+    if om != nm:
+        # Cross-dialect diff: an exhaustive distinct/s headline and a
+        # swarm steps/s headline measure different things — folding
+        # them into one regression ratio would gate noise.  The
+        # STAGE_FOLD rule applies: the diff stays a diff (both
+        # headlines reported, host guard above still live), nothing is
+        # gated.
+        d.note(f"bench modes differ (baseline: {om}, candidate: {nm}) "
+               f"— dialect rates are not comparable; reported, not "
+               f"gated")
+        for side, doc in (("baseline", old), ("candidate", new)):
+            val = doc.get("value")
+            if val is not None:
+                d.note(f"  {side} [{bench_mode(doc)}]: {val:,.1f} "
+                       f"{doc.get('unit', '?')}")
+        return d.render()
     diff_headline(old, new, d, args.max_regress)
     diff_phases(old, new, d, args.phase_max_regress, args.phase_floor)
+    if om == "swarm":
+        # Swarm-dialect axes; the exhaustive stage/perf/coverage axes
+        # have no meaning for a walker (no chunk_stages, no coverage
+        # object) and fall through as silent no-ops anyway.
+        diff_swarm(old, new, d, args.max_regress)
+        return d.render()
     diff_stages(old, new, d, args.stage_max_regress)
     diff_perf(old, new, d, args.launch_drift)
     diff_pruned(old, new, d, args.pruned_drift)

@@ -11,7 +11,11 @@ bench JSON that lets ``bench_diff.py --history`` auto-resolve baselines.
         render the trajectory table: one row per entry with its host
         key, plus explicit HOST-CHANGE / unknown-host flags — the
         BENCH_r05 trap (an absolute rate silently compared across a
-        ~4x slower container) rendered impossible to miss.
+        ~4x slower container) rendered impossible to miss.  Swarm-tier
+        rows (kind=swarm — ``check --mode swarm`` / BENCH_MODE=swarm)
+        render their steps/s headline with a ``steps/s`` dialect flag;
+        they carry real host fingerprints, so they never read as host
+        anomalies.
 
     python scripts/bench_history.py LEDGER.jsonl --import-legacy [DIR]
         one-time seeding from the committed BENCH_r01..r05 /
