@@ -99,13 +99,19 @@ def _swarm_bench(setup, platform: str) -> None:
     if events_file is None:
         scratch_dir = tempfile.mkdtemp(prefix="bench_obs_")
         events_file = os.path.join(scratch_dir, "events.jsonl")
+    # Same perf/profiler knobs as the exhaustive bench: BENCH_PERF=0
+    # disables launch accounting, BENCH_PROFILE_CHUNKS sets the
+    # walk-kernel stage-sampling cadence (0 = off).
+    perf_on = bool(int(os.environ.get("BENCH_PERF", "1")))
+    profile_every = int(os.environ.get("BENCH_PROFILE_CHUNKS", "64"))
     eng = SwarmEngine(setup.dims,
                       invariants=resolve_invariants(setup),
                       constraint=resolve_constraint(setup),
                       walks=walks, max_depth=max_depth,
                       batch=min(batch, walks), chunk=chunk, ring=ring,
                       pipeline=os.environ.get("BENCH_PIPELINE", "auto"),
-                      events_out=events_file)
+                      events_out=events_file, perf=perf_on,
+                      profile_chunks_every=profile_every)
     _mark(f"swarm engine built (walks={walks}, depth={max_depth}, "
           f"ring={ring}); compiling + running "
           + (f"{num_steps} steps" if num_steps is not None
@@ -162,7 +168,13 @@ def _swarm_bench(setup, platform: str) -> None:
         "phases": {k: round(v, 4) for k, v in res.phases.items()},
         "pipeline": res.pipeline,
         "report": res.report,
+        "perf": res.perf,
+        "chunk_stages": {k: round(v, 6)
+                         for k, v in res.chunk_stages.items()},
     }
+    if res.report.get("hunt"):
+        from raft_tla_tpu.obs import hunt as hunt_mod
+        doc["hunt"] = hunt_mod.summarize(res.report["hunt"])
     print(json.dumps(doc))
     history_path = os.environ.get("BENCH_HISTORY")
     if history_path:

@@ -312,17 +312,50 @@ def _run_swarm(args, setup, resolve, batch) -> int:
             resolve(args.counterexample_dir, "COUNTEREXAMPLE_DIR", None)
             or ("." if args.render_trace and not ckpt else None)),
         progress_seconds=float(
-            resolve(args.progress_interval, "PROGRESS_SECONDS", 5.0)))
+            resolve(args.progress_interval, "PROGRESS_SECONDS", 5.0)),
+        # The BFS branch's observability knobs, swarm dialect: --perf
+        # prices the scan-chunk launches, --profile-chunks samples the
+        # walk-kernel stages, --xla-profile captures device truth.
+        perf=bool(resolve(args.perf or None, "PERF", False)),
+        profile_chunks_every=resolve(args.profile_chunks,
+                                     "PROFILE_CHUNKS", None),
+        xla_profile_chunks=resolve(args.xla_profile, "XLA_PROFILE",
+                                   None),
+        xla_profile_dir=args.xla_profile_dir)
     max_seconds = (args.max_seconds if args.max_seconds is not None
                    else setup.max_seconds)
-    res = engine.run(initial_states(setup, seed=args.seed),
-                     seed=args.seed, max_seconds=max_seconds)
+    metrics_srv = None
+    metrics_port = resolve(args.metrics_port, "METRICS_PORT", None)
+    if metrics_port:
+        from .obs import start_metrics_server
+        from .obs.flight import RECORDER
+        try:
+            metrics_srv, _ = start_metrics_server(
+                int(metrics_port), engine.metrics, flight=RECORDER)
+            print(f"metrics: http://127.0.0.1:"
+                  f"{metrics_srv.server_address[1]}/metrics "
+                  f"(+ /flight)", file=sys.stderr)
+        except OSError as e:
+            metrics_srv = None
+            print(f"metrics: cannot listen on port {metrics_port} "
+                  f"({e}); continuing without the listener",
+                  file=sys.stderr)
+    try:
+        res = engine.run(initial_states(setup, seed=args.seed),
+                         seed=args.seed, max_seconds=max_seconds)
+    finally:
+        if metrics_srv is not None:
+            metrics_srv.shutdown()
+            metrics_srv.server_close()
     print(f"swarm: {res.walks} walks x depth {engine.max_depth} | "
           f"{res.steps} steps ({res.steps_per_second:,.0f} steps/s, "
           f"{res.walks_per_second:,.0f} walks/s) | visited "
           f"{res.visited} | traces {res.traces} | deepest "
           f"{res.diameter} | stop: {res.stop_reason} | "
           f"{res.wall_seconds:.2f}s")
+    if res.report.get("hunt"):
+        from .obs import hunt as hunt_mod
+        print(hunt_mod.render_report(res.report["hunt"]))
     if args.metrics_out:
         _write_metrics(args.metrics_out, engine.metrics)
     history_path = resolve(args.history, "HISTORY", None)
@@ -331,6 +364,10 @@ def _run_swarm(args, setup, resolve, batch) -> int:
         from .obs.flight import host_fingerprint
         with open(args.cfg) as f:
             cfg_text = f.read()
+        hunt_sum = None
+        if res.report.get("hunt"):
+            from .obs import hunt as hunt_mod
+            hunt_sum = hunt_mod.summarize(res.report["hunt"])
         history_mod.append_entry(
             history_path,
             history_mod.entry_from_result(
@@ -341,7 +378,8 @@ def _run_swarm(args, setup, resolve, batch) -> int:
                     "walks": res.walks,
                     "steps_per_sec": round(res.steps_per_second, 1),
                     "walks_per_sec": round(res.walks_per_second, 1),
-                    "violation_at_seconds": res.violation_at_seconds}}))
+                    "violation_at_seconds": res.violation_at_seconds},
+                    "hunt": hunt_sum}))
         print(f"history: entry appended to {history_path}",
               file=sys.stderr)
     if res.violation is not None:

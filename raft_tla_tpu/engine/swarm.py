@@ -61,7 +61,8 @@ from ..obs import (MetricsRegistry, RunEventLog, device_memory_stats,
 from ..obs.flight import RECORDER as _FLIGHT
 from ..ops.fingerprint import build_fingerprint
 from ..ops.walk_kernels import (CHOICE_STREAM, FAMILY_STREAM, INIT_STREAM,
-                                ROOT_STREAM, family_subset, preferred_choice,
+                                ROOT_STREAM, bloom_init, bloom_probe,
+                                bloom_push, family_subset, preferred_choice,
                                 ring_init, ring_probe, ring_push, ring_reset,
                                 walk_bits)
 from .bfs import Violation, _resolve_pipeline
@@ -98,6 +99,12 @@ class SwarmResult:
     #: swarm's headline "time to first counterexample" metric.
     violation_at_seconds: Optional[float] = None
     counterexample: Dict = dataclasses.field(default_factory=dict)
+    #: Performance observatory block (obs/perf.py; ``perf=True``) —
+    #: same shape as ``EngineResult.perf``.
+    perf: Dict = dataclasses.field(default_factory=dict)
+    #: ChunkProfiler stage means (``profile_chunks_every``) at the
+    #: swarm granularity (choose/expand/ring_probe/latch).
+    chunk_stages: Dict[str, float] = dataclasses.field(default_factory=dict)
     #: The visited-fingerprint multiset as an [N, 2] uint32 (hi, lo)
     #: array, ONLY when the engine was built with
     #: ``collect_fingerprints=True`` (the determinism tests) — a
@@ -119,7 +126,8 @@ class SwarmResult:
 
 
 def build_swarm_chunk(dims: RaftDims, inv_fns, constraint, D: int, R: int,
-                      chunk: int, pipeline: str = "auto"):
+                      chunk: int, pipeline: str = "auto",
+                      hunt: bool = False):
     """Returns ``chunk_fn(rows, roots, tstep, cur_root, abuf, ring_hi,
     ring_lo, ring_pos, epoch, walk_ids, seed, k0, k_limit)`` — one
     jitted scan advancing every lane ``chunk`` lockstep steps from
@@ -129,6 +137,19 @@ def build_swarm_chunk(dims: RaftDims, inv_fns, constraint, D: int, R: int,
     nothing accepted, nothing latched): the host can run an exact
     ``num_steps`` budget in chunk-sized dispatches without a remainder
     recompile.
+
+    With ``hunt=True`` (the hunt observatory, obs/hunt.py) the
+    signature grows two trailing args ``(bloom1, bloom2)`` — the
+    persistent seen>=1 / seen>=2 Bloom filters — the carry gains a
+    13th element of analytics tallies (updated filters, fresh/promote/
+    restart-reason scalars, the final-depth histogram, per-family
+    efficacy counters), and ``ys`` gains per-step fresh/accept counts.
+    Every hunt value is DERIVED from the walk decisions and feeds
+    nothing back: choice, accept, latch and the fingerprint stream are
+    bit-identical with hunt off (tests/test_swarm.py pins it).
+    Per-species observation counts are exact within a dispatch (an
+    O(lanes^2) same-fingerprint prior count joins the filters), so the
+    Good-Turing totals are partition-invariant up to Bloom collisions.
 
     The successor draw is **family-diversified** (Holzmann swarm
     style): each (walk, trace) draws a keep-subset of the model's
@@ -150,15 +171,17 @@ def build_swarm_chunk(dims: RaftDims, inv_fns, constraint, D: int, R: int,
     fam = jnp.asarray(np.repeat(
         np.arange(len(dims.family_sizes), dtype=np.int32),
         dims.family_sizes))
+    n_fam = len(dims.family_sizes)
 
     def chunk_fn(rows, roots, tstep, cur_root, abuf, ring_hi, ring_lo,
-                 ring_pos, epoch, walk_ids, seed, k0, k_limit):
+                 ring_pos, epoch, walk_ids, seed, k0, k_limit,
+                 *hunt_state):
         B = rows.shape[0]
         lanes = jnp.arange(B)
 
         def body(carry, k):
             (rows, tstep, cur_root, abuf, rh, rl, rp, epoch, restarts,
-             visited, depth_max, latch) = carry
+             visited, depth_max, latch) = carry[:12]
             act = k < k_limit
             states = jax.vmap(unflatten_state, (0, None))(rows, dims)
             if v2 is None:
@@ -219,6 +242,60 @@ def build_swarm_chunk(dims: RaftDims, inv_fns, constraint, D: int, R: int,
             # Restart on: dead end, overflow, constraint stop, ring
             # revisit (all folded into ~accept) or the depth bound.
             restart = (~accept | (tstep + 1 >= D)) & act
+
+            if hunt:
+                # Hunt observatory tallies — every value below is
+                # derived from the decisions already made above and
+                # feeds NOTHING back into them (the on/off bit-identity
+                # contract).  Species accounting: the two persistent
+                # Bloom filters give each accepted visit's prior
+                # observation count (capped at 2), exact within this
+                # dispatch via the same-fingerprint prior count over
+                # earlier lanes of the same step.
+                (b1, b2, fresh_t, promote_t, revisit_t, dead_t, povf_t,
+                 cons_t, dbound_t, dhist, fch, fac, ffr) = carry[12]
+                in1 = bloom_probe(b1, fp_hi, fp_lo)
+                in2 = bloom_probe(b2, fp_hi, fp_lo)
+                eqm = ((fp_hi[:, None] == fp_hi[None, :])
+                       & (fp_lo[:, None] == fp_lo[None, :])
+                       & accept[None, :])
+                prior = jnp.sum(jnp.tril(eqm, -1), axis=1, dtype=_I32)
+                nobs = in1.astype(_I32) + in2.astype(_I32) + prior
+                fresh = accept & (nobs == 0)
+                promote = accept & (nobs == 1)
+                b1 = bloom_push(b1, fp_hi, fp_lo, accept)
+                b2 = bloom_push(b2, fp_hi, fp_lo, accept & (nobs >= 1))
+                # Restart-reason census, in the engine's decision order
+                # (the first failing rule owns the restart): together
+                # with the depth bound these partition ``restart``.
+                anyovf = jnp.any(ovf, axis=1)
+                deadend = ~can_step & act
+                ovfstop = can_step & anyovf
+                consstop = can_step & ~anyovf & ~cons_ok
+                revisit = can_step & ~anyovf & cons_ok & seen
+                dbound = accept & (tstep + 1 >= D)
+                # Final depth of each completed trace (masked lanes
+                # contribute an add of 0 — scatter-add, never a branch).
+                dfin = jnp.clip(jnp.where(accept, tstep + 1, tstep),
+                                0, D)
+                dhist = dhist.at[dfin].add(restart.astype(_I32))
+                # Per-family efficacy: which diversification families
+                # get chosen, land accepted states, and find FRESH ones.
+                fidx = fam[choice]
+                fch = fch.at[fidx].add(can_step.astype(_I32))
+                fac = fac.at[fidx].add(accept.astype(_I32))
+                ffr = ffr.at[fidx].add(fresh.astype(_I32))
+                hcarry = (b1, b2,
+                          fresh_t + jnp.sum(fresh, dtype=_I32),
+                          promote_t + jnp.sum(promote, dtype=_I32),
+                          revisit_t + jnp.sum(revisit, dtype=_I32),
+                          dead_t + jnp.sum(deadend, dtype=_I32),
+                          povf_t + jnp.sum(ovfstop, dtype=_I32),
+                          cons_t + jnp.sum(consstop, dtype=_I32),
+                          dbound_t + jnp.sum(dbound, dtype=_I32),
+                          dhist, fch, fac, ffr)
+                hys = (jnp.sum(fresh, dtype=_I32),
+                       jnp.sum(accept, dtype=_I32))
             root_idx = (walk_bits(seed, walk_ids, k, ROOT_STREAM)
                         % _U32(roots.shape[0])).astype(_I32)
             rows = jnp.where(restart[:, None], roots[root_idx],
@@ -235,15 +312,25 @@ def build_swarm_chunk(dims: RaftDims, inv_fns, constraint, D: int, R: int,
             epoch = epoch + restart.astype(_I32)
             restarts = restarts + jnp.sum(restart, dtype=_I32)
             visited = visited + jnp.sum(accept, dtype=_I32)
-            return (rows, tstep, cur_root, abuf, rh, rl, rp, epoch,
-                    restarts, visited, depth_max, latch), \
-                (fp_hi, fp_lo, accept)
+            out = (rows, tstep, cur_root, abuf, rh, rl, rp, epoch,
+                   restarts, visited, depth_max, latch)
+            if hunt:
+                return out + (hcarry,), (fp_hi, fp_lo, accept) + hys
+            return out, (fp_hi, fp_lo, accept)
 
         latch0 = (jnp.bool_(False), jnp.int32(-1), jnp.int32(0),
                   jnp.int32(0), jnp.zeros((D,), _I32), jnp.int32(-1),
                   jnp.int32(-1), jnp.int32(-1), _U32(0), _U32(0))
         carry0 = (rows, tstep, cur_root, abuf, ring_hi, ring_lo, ring_pos,
                   epoch, jnp.int32(0), jnp.int32(0), jnp.int32(0), latch0)
+        if hunt:
+            bloom1, bloom2 = hunt_state
+            z = jnp.int32(0)
+            carry0 = carry0 + ((bloom1, bloom2, z, z, z, z, z, z, z,
+                                jnp.zeros((D + 1,), _I32),
+                                jnp.zeros((n_fam,), _I32),
+                                jnp.zeros((n_fam,), _I32),
+                                jnp.zeros((n_fam,), _I32)),)
         ks = k0 + jnp.arange(chunk, dtype=_I32)
         return jax.lax.scan(body, carry0, ks)
 
@@ -272,7 +359,12 @@ class SwarmEngine:
                  counterexample_dir: Optional[str] = None,
                  collect_fingerprints: bool = False,
                  progress_seconds: float = 5.0,
-                 run_context_extra: Optional[dict] = None):
+                 run_context_extra: Optional[dict] = None,
+                 hunt: bool = True, hunt_cells: int = 1 << 20,
+                 perf: bool = False,
+                 profile_chunks_every: Optional[int] = None,
+                 xla_profile_chunks: Optional[int] = None,
+                 xla_profile_dir: Optional[str] = None):
         if walks < 1:
             raise ValueError(f"walks must be >= 1, got {walks}")
         if max_depth < 1:
@@ -291,12 +383,69 @@ class SwarmEngine:
         self.collect_fingerprints = collect_fingerprints
         self.progress_seconds = progress_seconds
         self.run_context_extra = run_context_extra
+        #: Hunt observatory (obs/hunt.py): ON by default — the tallies
+        #: are a handful of scalars per chunk and the saturation gauge
+        #: is the product's whole "when to stop" answer.  ``hunt=False``
+        #: builds the bare chunk program (the bit-identity reference
+        #: and the throughput ceiling).
+        self.hunt = hunt
+        self.hunt_cells = int(hunt_cells)
+        self._hunt_acc = None
         self.pipeline_name = ("v2" if _resolve_pipeline(pipeline, dims)
                               is not None else "v1")
         inv_id = build_inv_id(inv_fns)
         self._chunk = jax.jit(build_swarm_chunk(
             dims, inv_fns, constraint, max_depth, ring, chunk,
-            pipeline=pipeline))
+            pipeline=pipeline, hunt=hunt))
+        # Per-stage chunk profiler at the swarm granularity
+        # (choose/expand/ring_probe/latch; obs/profile.py).  Same
+        # cadence contract as the BFS engine: --perf implies sparse
+        # sampling (every 16th) when no cadence was chosen; an explicit
+        # 0 keeps it off.
+        prof_every = (profile_chunks_every
+                      if profile_chunks_every is not None
+                      else (16 if perf else None))
+        self._profiler = None
+        if prof_every:
+            from ..obs import ChunkProfiler
+            self._profiler = ChunkProfiler(
+                dims, batch=self.batch, lanes=dims.n_instances,
+                seen_capacity=1 << 10, pipeline="swarm",
+                swarm_pipeline=self.pipeline_name, ring=ring,
+                every=prof_every, metrics=self.metrics)
+        # Performance observatory (obs/perf.py): trace THE jitted chunk
+        # program above — scan body, hunt tallies and all — for the
+        # CI-pinned static launch model, plus the walk-kernel stage
+        # traffic floors for the roofline.  Fail-soft like the BFS
+        # engine's: a failed model degrades to nulls, never a failed
+        # engine build.
+        self._perf = None
+        if perf:
+            from ..models.schema import state_width
+            from ..obs import perf as perf_mod
+            B = self.batch
+            sw = state_width(dims)
+            i32 = jax.ShapeDtypeStruct((), jnp.int32)
+            u32 = jax.ShapeDtypeStruct((), jnp.uint32)
+            li32 = jax.ShapeDtypeStruct((B,), jnp.int32)
+            avals = (jax.ShapeDtypeStruct((B, sw), jnp.uint8),
+                     jax.ShapeDtypeStruct((2, sw), jnp.uint8),
+                     li32, li32,
+                     jax.ShapeDtypeStruct((B, max_depth), jnp.int32),
+                     jax.ShapeDtypeStruct((B, ring), jnp.uint32),
+                     jax.ShapeDtypeStruct((B, ring), jnp.uint32),
+                     li32, li32, li32, u32, i32, i32)
+            if hunt:
+                bl = jax.ShapeDtypeStruct((self.hunt_cells,), jnp.uint8)
+                avals = avals + (bl, bl)
+            self._perf = perf_mod.build_accounting(
+                pipeline="swarm", chunk_fn=self._chunk,
+                chunk_avals=avals, dims=dims, B=B, K=dims.n_instances,
+                ring=ring, swarm_pipeline=self.pipeline_name,
+                metrics=self.metrics, engine="swarm")
+        self._xla_chunks = xla_profile_chunks
+        self._xla_dir = xla_profile_dir
+        self._xla_capture = None
 
         def roots_inv(batch):
             # Unpacked int32 StateBatch (simulator rule): uint8 packing
@@ -336,6 +485,20 @@ class SwarmEngine:
         mt = self.metrics
         if num_steps is None and max_seconds is None:
             num_steps = self.max_depth
+        # Per-run telemetry state (warm engines reuse the static
+        # halves: compiled programs, launch model, stage programs).
+        self._hunt_acc = None
+        if self._profiler is not None:
+            self._profiler.reset()
+        if self._perf is not None:
+            self._perf.reset()
+        self._xla_capture = None
+        if self._xla_chunks:
+            from ..obs import XlaProfileCapture
+            self._xla_capture = XlaProfileCapture(
+                self._xla_dir or os.path.join(
+                    self.checkpoint_dir or ".", "xla_profile"),
+                self._xla_chunks)
         t0 = time.time()
         evlog = RunEventLog(events_path(self.events_out,
                                         self.checkpoint_dir))
@@ -376,6 +539,36 @@ class SwarmEngine:
                     import sys as _sys
                     print(f"counterexample render failed: "
                           f"{type(e).__name__}: {e}", file=_sys.stderr)
+            # Profiler / perf / device-capture run-end hooks, the BFS
+            # engine's order: the profiler lands its means first (the
+            # roofline's measured half), perf prices them, the capture
+            # window closes whether the run lived or died.
+            if self._profiler is not None:
+                res.chunk_stages = self._profiler.stage_means()
+                self._profiler.finish(evlog)
+            if self._perf is not None and err is None:
+                try:
+                    res.perf = self._perf.finish(
+                        evlog, chunk_stages=res.chunk_stages)
+                except Exception as e:
+                    import sys as _sys
+                    print(f"perf: block assembly failed "
+                          f"({type(e).__name__}: {e})", file=_sys.stderr)
+            if self._xla_capture is not None:
+                self._xla_capture.finish(evlog)
+            # The hunt report (obs/hunt.py): the swarm sibling of the
+            # statespace report, riding the same surfaces — its own
+            # ``hunt`` run event, the report dict, gauges, flight ring.
+            hunt_report = None
+            if self._hunt_acc is not None and err is None:
+                from ..obs import hunt as hunt_mod
+                hunt_report = hunt_mod.build_report(
+                    self._hunt_acc,
+                    violation_at_seconds=res.violation_at_seconds,
+                    wall_seconds=res.wall_seconds)
+                evlog.emit("hunt", hunt=hunt_report)
+                hunt_mod.feed_metrics(hunt_report, mt)
+                _FLIGHT.record("hunt", **self._hunt_acc.snapshot())
             swarm_block = self._swarm_block(res)
             if err is None:
                 res.report = {
@@ -387,6 +580,8 @@ class SwarmEngine:
                     "mode": "swarm",
                     "swarm": swarm_block,
                 }
+                if hunt_report is not None:
+                    res.report["hunt"] = hunt_report
                 evlog.emit("statespace", report=res.report)
             pm_path = None
             if err is not None:
@@ -410,14 +605,20 @@ class SwarmEngine:
 
     def _swarm_block(self, res: SwarmResult) -> dict:
         """The ``swarm`` payload object shared by ``swarm_progress``,
-        ``run_end``, and the statespace report."""
-        return {"walks": res.walks, "steps": res.steps,
-                "visited": res.visited, "traces": res.traces,
-                "max_depth": self.max_depth, "ring": self.ring,
-                "steps_per_sec": round(res.steps_per_second, 1),
-                "walks_per_sec": round(res.walks_per_second, 1),
-                "visited_per_sec": round(res.states_per_second, 1),
-                "violation_at_seconds": res.violation_at_seconds}
+        ``run_end``, and the statespace report.  Hunt-enabled runs
+        embed the live hunt snapshot (saturation, unseen mass, recent
+        novelty) so a ``watch`` stream answers "when to stop" from the
+        progress line alone."""
+        out = {"walks": res.walks, "steps": res.steps,
+               "visited": res.visited, "traces": res.traces,
+               "max_depth": self.max_depth, "ring": self.ring,
+               "steps_per_sec": round(res.steps_per_second, 1),
+               "walks_per_sec": round(res.walks_per_second, 1),
+               "visited_per_sec": round(res.states_per_second, 1),
+               "violation_at_seconds": res.violation_at_seconds}
+        if self._hunt_acc is not None:
+            out["hunt"] = self._hunt_acc.snapshot()
+        return out
 
     def _prepare_roots(self, roots: List[PyState], res: SwarmResult):
         """TLC checks invariants on initial states too: a violating
@@ -483,29 +684,68 @@ class SwarmEngine:
         mt.counter("swarm/walks", W)
         mt.gauge("swarm/active_walks", W)
 
+        hunt_args = ()
+        if self.hunt:
+            from ..obs import hunt as hunt_mod
+            self._hunt_acc = hunt_mod.HuntAccumulator(
+                self.dims.family_names, D,
+                bloom_cells=self.hunt_cells)
+            # The filters are SHARED across slices, threaded through
+            # the sequential dispatches: the Good-Turing totals then
+            # see one global observation stream regardless of how the
+            # walks were sliced (only the per-step series reorders).
+            hunt_args = (jax.device_put(bloom_init(self.hunt_cells), dev),
+                         jax.device_put(bloom_init(self.hunt_cells), dev))
+        hacc = self._hunt_acc
+        prof = self._profiler
+        cap = self._xla_capture
+
         fps_acc: List[np.ndarray] = []
         k0 = 0
         depth_max = 0
         last_progress = t0
         while True:
+            if prof is not None and prof.want():
+                # Observational side-channel: re-run the first (always
+                # full-width) slice's current rows through the staged
+                # walk-kernel programs for per-stage timings.
+                prof.sample(slices[0]["rows"],
+                            np.ones((self.batch,), bool))
+            tc0 = time.perf_counter()
             with mt.phase_timer("swarm_chunk"):
-                for s in slices:
-                    carry, ys = self._chunk(
-                        s["rows"], roots_j, s["tstep"], s["cur_root"],
-                        s["abuf"], s["ring_hi"], s["ring_lo"],
-                        s["ring_pos"], s["epoch"], s["walk_ids"], seed_j,
-                        jnp.int32(k0), k_limit)
-                    (s["rows"], s["tstep"], s["cur_root"], s["abuf"],
-                     s["ring_hi"], s["ring_lo"], s["ring_pos"],
-                     s["epoch"], s["restarts"], s["visited_d"],
-                     s["depth_d"], s["latch"]) = carry
-                    s["ys"] = ys
+                step_cm = cap.step() if cap is not None else None
+                if step_cm is not None:
+                    step_cm.__enter__()
+                try:
+                    for s in slices:
+                        carry, ys = self._chunk(
+                            s["rows"], roots_j, s["tstep"],
+                            s["cur_root"], s["abuf"], s["ring_hi"],
+                            s["ring_lo"], s["ring_pos"], s["epoch"],
+                            s["walk_ids"], seed_j, jnp.int32(k0),
+                            k_limit, *hunt_args)
+                        (s["rows"], s["tstep"], s["cur_root"], s["abuf"],
+                         s["ring_hi"], s["ring_lo"], s["ring_pos"],
+                         s["epoch"], s["restarts"], s["visited_d"],
+                         s["depth_d"], s["latch"]) = carry[:12]
+                        s["ys"] = ys
+                        if self.hunt:
+                            s["hunt"] = carry[12]
+                            hunt_args = carry[12][:2]
+                finally:
+                    if step_cm is not None:
+                        step_cm.__exit__(None, None, None)
             stepped = min(self.chunk,
                           max(0, int(k_limit) - k0)) if num_steps \
                 else self.chunk
+            if self._perf is not None:
+                self._perf.add_chunk(len(slices),
+                                     time.perf_counter() - tc0)
+            k_start = k0
             k0 += self.chunk
             res.steps += W * stepped
             fired = []
+            novel_steps = accept_steps = None
             with mt.phase_timer("swarm_fetch"):
                 for s in slices:
                     res.traces += int(s["restarts"])
@@ -517,12 +757,35 @@ class SwarmEngine:
                     vf = bool(s["latch"][0])
                     if vf:
                         fired.append(s["latch"])
+                    if hacc is not None:
+                        hc = s["hunt"]
+                        hacc.add_slice(
+                            fresh=int(hc[2]), promote=int(hc[3]),
+                            # RESTART_REASONS order: deadend, overflow,
+                            # constraint, revisit, depth_bound.
+                            reasons=(int(hc[5]), int(hc[6]), int(hc[7]),
+                                     int(hc[4]), int(hc[8])),
+                            depth_hist=np.asarray(hc[9]),
+                            fam_chosen=np.asarray(hc[10]),
+                            fam_accept=np.asarray(hc[11]),
+                            fam_fresh=np.asarray(hc[12]))
+                        nv = np.asarray(s["ys"][3])
+                        av = np.asarray(s["ys"][4])
+                        novel_steps = (nv if novel_steps is None
+                                       else novel_steps + nv)
+                        accept_steps = (av if accept_steps is None
+                                        else accept_steps + av)
                     if self.collect_fingerprints:
-                        hi, lo, acc = (np.asarray(a) for a in s["ys"])
+                        hi, lo, acc = (np.asarray(a)
+                                       for a in s["ys"][:3])
                         m = acc.reshape(-1)
                         fps_acc.append(np.stack(
                             [hi.reshape(-1)[m], lo.reshape(-1)[m]],
                             axis=1))
+            if hacc is not None and stepped:
+                hacc.add_steps(k_start + stepped, W * stepped,
+                               novel_steps[:stepped],
+                               accept_steps[:stepped])
             mt.counter("swarm/steps", W * stepped)
             res.diameter = depth_max
             now = time.time()
@@ -532,8 +795,21 @@ class SwarmEngine:
                 res.wall_seconds = now - t0
                 evlog.emit("swarm_progress", depth=k0,
                            swarm=self._swarm_block(res))
+                flight_extra = {}
+                if hacc is not None:
+                    snap = hacc.snapshot()
+                    mt.gauge("hunt/saturation", snap["saturation"])
+                    mt.gauge("hunt/unseen_mass", snap["unseen_mass"])
+                    mt.gauge("hunt/distinct_observed",
+                             snap["distinct_observed"])
+                    mt.gauge("hunt/novel_rate",
+                             snap["novel_rate_recent"])
+                    mt.gauge("hunt/revisit_rate", snap["revisit_rate"])
+                    _FLIGHT.record("hunt", steps=res.steps, **snap)
+                    flight_extra["saturation"] = snap["saturation"]
                 _FLIGHT.progress(mode="swarm", steps=res.steps,
-                                 visited=res.visited, traces=res.traces)
+                                 visited=res.visited, traces=res.traces,
+                                 **flight_extra)
             if fired:
                 # Globally first violation in (step, walk) order — the
                 # partition-invariant pick across slices.
@@ -556,6 +832,9 @@ class SwarmEngine:
             if num_steps is not None and k0 >= num_steps:
                 res.stop_reason = "steps"
                 break
+        if hacc is not None and hunt_args:
+            b1 = np.asarray(hunt_args[0])
+            hacc.bloom_load = float(np.count_nonzero(b1)) / b1.size
         if self.collect_fingerprints:
             res.visited_fingerprints = (
                 np.concatenate(fps_acc, axis=0) if fps_acc

@@ -64,6 +64,10 @@ KNOWN_EVENTS = (
     # ``run_end`` (exhaustive run_ends carry none, so only the
     # progress event gets schema-table enforcement).
     "swarm_progress",   # walker-fleet progress; payload: "swarm"
+    # Hunt observatory (obs/hunt.py): the run-end saturation /
+    # walk-analytics report for swarm runs — the probabilistic sibling
+    # of ``statespace``.
+    "hunt",             # swarm coverage report; payload: "hunt"
 )
 
 #: Structured payload field each new event type must carry.
@@ -71,7 +75,7 @@ _EVENT_PAYLOAD_FIELDS = {"chunk_profile": "stages", "coverage": "actions",
                          "postmortem": "dump", "watch_attach": "client",
                          "xla_profile": "capture", "statespace": "report",
                          "perf": "perf", "skew": "balance",
-                         "swarm_progress": "swarm"}
+                         "swarm_progress": "swarm", "hunt": "hunt"}
 
 
 #: memory_stats() keys kept in event payloads (one extraction for the

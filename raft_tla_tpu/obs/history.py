@@ -259,7 +259,24 @@ def perf_columns(entry: dict):
     return lpc, top, (max(fracs) if fracs else None)
 
 
-def render_table(entries: List[dict], perf: bool = False) -> str:
+def hunt_columns(entry: dict):
+    """(saturation, novel rate, time-to-violation seconds) from a swarm
+    entry's hunt summary (obs/hunt.py summarize) — carried either as
+    the entry's own ``hunt`` extra (``check --mode swarm --history``,
+    the server's swarm leg) or inside the embedded bench doc
+    (BENCH_MODE=swarm).  (None, None, None) for exhaustive rows and
+    hunt-less swarm rows, so the trajectory renders '--'."""
+    hunt = entry.get("hunt")
+    if not isinstance(hunt, dict):
+        hunt = (entry.get("bench") or {}).get("hunt")
+    if not isinstance(hunt, dict):
+        return None, None, None
+    return (hunt.get("saturation"), hunt.get("novel_rate"),
+            hunt.get("time_to_violation_seconds"))
+
+
+def render_table(entries: List[dict], perf: bool = False,
+                 hunt: bool = False) -> str:
     """The trajectory table (scripts/bench_history.py): one row per
     entry, host-key column + explicit flags where adjacent entries are
     NOT rate-comparable (different or unknown host) — the r05 trap,
@@ -268,12 +285,17 @@ def render_table(entries: List[dict], perf: bool = False) -> str:
     fraction + advisor pick) so the trajectory shows whether fusion
     work (v3's fused tail, v4's megakernel) is actually RETIRING
     launches and raising saturation across rounds, not just moving
-    wall-clock."""
+    wall-clock.  ``hunt=True`` adds the hunt-observatory columns
+    (coverage saturation + novelty rate + time-to-violation from
+    obs/hunt.py summaries) so a swarm trajectory answers "is each
+    round's hunt saturating sooner / latching faster" at a glance."""
     pcols = (f" {'pipe':>4s} {'launch/chunk':>12s} {'bw-frac':>8s} "
              f"{'advisor':14s}") if perf else ""
+    hcols = (f" {'satur':>7s} {'novel':>7s} {'t-viol':>7s}") if hunt \
+        else ""
     lines = [f"{'#':>3s} {'label':20s} {'kind':9s} {'host':10s} "
              f"{'distinct/s':>12s} {'distinct':>12s} {'diam':>5s} "
-             f"{'verdict':10s}{pcols} flags"]
+             f"{'verdict':10s}{pcols}{hcols} flags"]
     first = object()
     prev_key = first              # sentinel: first row never flags
     warnings = []
@@ -322,6 +344,16 @@ def render_table(entries: List[dict], perf: bool = False) -> str:
                     + (f" {bw:8.1%}" if isinstance(bw, (int, float))
                        else f" {'--':>8s}")
                     + f" {str(top or '--'):14s}")
+        if hunt:
+            sat, novel, ttv = hunt_columns(e)
+            row += ((f" {sat:7.1%}" if isinstance(sat, (int, float))
+                     else f" {'--':>7s}")
+                    + (f" {novel:7.1%}"
+                       if isinstance(novel, (int, float))
+                       else f" {'--':>7s}")
+                    + (f" {ttv:6.1f}s"
+                       if isinstance(ttv, (int, float))
+                       else f" {'--':>7s}"))
         row += " " + (",".join(flags) if flags else "-")
         lines.append(row)
         prev_key = key

@@ -305,14 +305,22 @@ def build_accounting(*, pipeline: str, chunk_fn, chunk_avals,
                      K: Optional[int] = None,
                      compact_method: str = "scatter", v3_force=None,
                      plan=None, with_stages: bool = True,
-                     metrics=None, engine: str = "engine"
+                     metrics=None, engine: str = "engine",
+                     ring: int = 16, swarm_pipeline: str = "v1"
                      ) -> PerfAccounting:
     """Build one engine's PerfAccounting at construction time: trace the
     real chunk program for the launch model and (single-chip) the shared
     stage programs for the roofline traffic.  Fail-soft by construction:
     a model that cannot be built warns on stderr (named by ``engine``)
     and degrades to a perf block with nulls — same resolved ``pipeline``
-    label either way — never a failed engine build."""
+    label either way — never a failed engine build.
+
+    ``pipeline="swarm"`` prices the swarm tier instead: the traced
+    chunk is the whole lockstep scan (launches_per_batch then counts
+    device ops per scan STEP — the swarm's per-step pin next to the
+    BFS per-batch ones), and the roofline rows come from the
+    walk-kernel stage programs (``ring``/``swarm_pipeline`` mirror the
+    engine's ring capacity and resolved expand pipeline)."""
     from . import roofline as roofline_mod
     launch_model = None
     traffic = None
@@ -321,8 +329,10 @@ def build_accounting(*, pipeline: str, chunk_fn, chunk_avals,
         if with_stages and dims is not None:
             traffic = roofline_mod.stage_traffic(
                 dims, B, K,
-                pipeline=pipeline if pipeline in ("v3", "v4") else "v1",
-                compact_method=compact_method, v3_force=v3_force)
+                pipeline=(pipeline if pipeline in ("v3", "v4", "swarm")
+                          else "v1"),
+                compact_method=compact_method, v3_force=v3_force,
+                ring=ring, swarm_pipeline=swarm_pipeline)
     except Exception as e:
         print(f"perf: {engine} launch/roofline model unavailable "
               f"({type(e).__name__}: {e}); continuing without",

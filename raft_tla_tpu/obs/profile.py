@@ -47,6 +47,16 @@ engine is not executing:
     front           masks + POR + compact + fingerprint megakernel
     insert_enqueue  the fused probe/insert -> DMA-append tail
 
+``pipeline="swarm"`` profiles the walk-kernel decomposition of the
+swarm tier's lockstep scan body (engine/swarm.py) instead of a
+frontier chunk — same fencing discipline, swarm stage headings:
+
+    expand        unflatten + enabled/overflow masks (v1 full expand
+                  or v2 guards-only, matching the engine's pipeline)
+    choose        counter-PRNG draws + family-diversified choice
+    latch         chosen-successor materialization + fingerprint
+    ring_probe    per-walk ring dedup probe -> push -> restart reset
+
 ``scripts/bench_diff.py`` folds the granularities onto common coarse
 stages when diffing across pipelines.
 
@@ -63,6 +73,7 @@ from typing import Dict, Optional
 STAGES = ("expand", "fingerprint", "dedup_insert", "enqueue")
 STAGES_V3 = ("masks", "compact", "fingerprint", "insert_enqueue")
 STAGES_V4 = ("front", "insert_enqueue")
+STAGES_SWARM = ("expand", "choose", "latch", "ring_probe")
 
 STAGE_PREFIX = "chunk_stage/"
 
@@ -322,6 +333,102 @@ def build_stage_programs_v4(dims, B: int, K: int,
     }
 
 
+def build_stage_programs_swarm(dims, B: int, R: int,
+                               pipeline: str = "v1") -> dict:
+    """Stage programs at the swarm walk-kernel granularity
+    (STAGES_SWARM), mirroring one lockstep step of
+    ``engine/swarm.py``'s scan body for lane count ``B`` and ring
+    capacity ``R``.  ``pipeline`` is the ENGINE'S resolved expand
+    pipeline name ("v1" full expand or "v2" guards-only), so the
+    profiled expand stage prices the masks the engine actually runs.
+
+    The profiled step is the decision core only: invariant evaluation
+    and the violation latch are not mirrored (same rule as the v3/v4
+    profilers' all-true ``cons``), and the PRNG is keyed on a
+    synthetic ``(seed=0, walk=lane, step=sample)`` tuple — timings
+    need representative control flow, not the engine's draws.  The
+    per-sample rings persist in the :class:`ChunkProfiler`, so probe
+    cost sees a realistically loaded ring, not a cold sentinel one.
+    Returns ``{stage: fn, "total": fn, "ring_capacity": R}``."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from ..models.actions import build_expand
+    from ..models.schema import (build_pack_guard, flatten_state,
+                                 unflatten_state)
+    from ..ops.fingerprint import build_fingerprint
+    from ..ops.walk_kernels import (CHOICE_STREAM, FAMILY_STREAM,
+                                    family_subset, preferred_choice,
+                                    ring_probe, ring_push, ring_reset,
+                                    walk_bits)
+
+    _I32 = jnp.int32
+    fingerprint = build_fingerprint(dims)
+    fam = jnp.asarray(np.repeat(
+        np.arange(len(dims.family_sizes), dtype=np.int32),
+        dims.family_sizes))
+    walk_ids = jnp.arange(B, dtype=jnp.int32)
+    epoch = jnp.zeros((B,), jnp.int32)
+    seed = jnp.uint32(0)
+    lanes = jnp.arange(B)
+    v2 = None
+    if pipeline == "v2":
+        from ..models.actions2 import build_v2
+        v2 = build_v2(dims)
+    expand = None if v2 is not None else build_expand(dims)
+    pack_ok = None if v2 is not None else build_pack_guard(dims)
+
+    def s_expand(rows, valid):
+        states = jax.vmap(unflatten_state, (0, None))(rows, dims)
+        if v2 is None:
+            cands, en, ovf = jax.vmap(expand)(states)
+            ovf = ovf | (en & ~jax.vmap(jax.vmap(pack_ok))(cands))
+            packed = cands
+        else:
+            en, ovf = jax.vmap(v2.masks)(states)
+            packed = states
+        return packed, en & valid[:, None], ovf
+
+    def s_choose(en, k):
+        bits = walk_bits(seed, walk_ids, k, CHOICE_STREAM)
+        mbits = walk_bits(seed, walk_ids, epoch, FAMILY_STREAM)
+        return preferred_choice(bits, en, family_subset(mbits, fam))
+
+    def s_latch(packed, choice):
+        if v2 is None:
+            nxt = jax.tree.map(lambda a: a[lanes, choice], packed)
+        else:
+            ph = jax.vmap(v2.parent_hash)(packed)
+            _h, _l, nxt = jax.vmap(v2.lane_out)(packed, ph,
+                                                choice.astype(_I32))
+        nrows = jax.vmap(flatten_state, (0, None))(nxt, dims)
+        fp_hi, fp_lo = jax.vmap(fingerprint)(nxt)
+        return nrows, fp_hi, fp_lo
+
+    def s_ring(rh, rl, rp, fp_hi, fp_lo, en, ovf):
+        seen = ring_probe(rh, rl, fp_hi, fp_lo)
+        accept = (jnp.any(en, axis=1) & ~jnp.any(ovf, axis=1) & ~seen)
+        rh, rl, rp = ring_push(rh, rl, rp, fp_hi, fp_lo, accept)
+        rh, rl, rp = ring_reset(rh, rl, rp, ~accept)
+        return rh, rl, rp, jnp.sum(accept, dtype=_I32)
+
+    def s_total(rows, valid, rh, rl, rp, k):
+        packed, en, ovf = s_expand(rows, valid)
+        choice = s_choose(en, k)
+        _nrows, fp_hi, fp_lo = s_latch(packed, choice)
+        return s_ring(rh, rl, rp, fp_hi, fp_lo, en, ovf)
+
+    return {
+        "expand": jax.jit(s_expand),
+        "choose": jax.jit(s_choose),
+        "latch": jax.jit(s_latch),
+        "ring_probe": jax.jit(s_ring),
+        "total": jax.jit(s_total),
+        "ring_capacity": R,
+    }
+
+
 class ChunkProfiler:
     """Samples every ``every``-th chunk call of one engine run.
 
@@ -333,7 +440,8 @@ class ChunkProfiler:
     def __init__(self, dims, *, batch: int, lanes: int,
                  seen_capacity: int, compact_method: str = "scatter",
                  pipeline: str = "v1", v3_force=None, every: int = 1,
-                 metrics=None):
+                 metrics=None, swarm_pipeline: str = "v1",
+                 ring: int = 16):
         self.dims = dims
         self.B, self.K = int(batch), int(lanes)
         self.seen_capacity = int(seen_capacity)
@@ -344,13 +452,18 @@ class ChunkProfiler:
         self.v3_force = v3_force
         # "v1" = the classical NORTHSTAR-budget decomposition (default,
         # cross-pipeline comparable); "v3"/"v4" = the fused-stage
-        # decomposition that chunk actually executes.
-        if pipeline not in ("v1", "v3", "v4"):
-            raise ValueError(f"profiler pipeline must be v1/v3/v4, "
-                             f"got {pipeline!r}")
+        # decomposition that chunk actually executes; "swarm" = the
+        # walk-kernel step of the swarm tier (swarm_pipeline names the
+        # engine's resolved expand pipeline, ring its dedup capacity).
+        if pipeline not in ("v1", "v3", "v4", "swarm"):
+            raise ValueError(f"profiler pipeline must be "
+                             f"v1/v3/v4/swarm, got {pipeline!r}")
         self.pipeline = pipeline
-        self.stages = {"v3": STAGES_V3,
-                       "v4": STAGES_V4}.get(pipeline, STAGES)
+        self.swarm_pipeline = swarm_pipeline
+        self.ring_capacity = int(ring)
+        self._swarm_k = 0
+        self.stages = {"v3": STAGES_V3, "v4": STAGES_V4,
+                       "swarm": STAGES_SWARM}.get(pipeline, STAGES)
         self.every = max(1, int(every))
         self.metrics = metrics
         self.samples = 0
@@ -378,6 +491,23 @@ class ChunkProfiler:
     def _build(self, rows, valid):
         import jax
         import jax.numpy as jnp
+        if self.pipeline == "swarm":
+            from ..ops.walk_kernels import ring_init
+            progs = build_stage_programs_swarm(
+                self.dims, self.B, self.ring_capacity,
+                pipeline=self.swarm_pipeline)
+            # Two persistent ring sets, the swarm analogue of the
+            # staged/fused FPSet pair below: both paths see the same
+            # probe-load trajectory across samples.
+            self._ring_s = ring_init(self.B, self.ring_capacity)
+            self._ring_t = ring_init(self.B, self.ring_capacity)
+            self._staged_chain(progs, rows, valid)
+            rh, rl, rp, n = progs["total"](rows, valid, *self._ring_t,
+                                           jnp.int32(0))
+            self._ring_t = (rh, rl, rp)
+            jax.block_until_ready((self._ring_s[0], rh, n))
+            self._built = progs
+            return progs
         if self.pipeline == "v3":
             progs = build_stage_programs_v3(self.dims, self.B, self.K,
                                             self.compact_method,
@@ -408,6 +538,19 @@ class ChunkProfiler:
         when ``fence`` is given (the shared driver for warm-up and
         sampling; one sequence per stage granularity)."""
         fence = fence or (lambda stage, out: out)
+        if self.pipeline == "swarm":
+            import jax.numpy as jnp
+            k = jnp.int32(self._swarm_k)
+            packed, en, ovf = fence(
+                "expand", progs["expand"](rows, valid))
+            choice = fence("choose", progs["choose"](en, k))
+            _nrows, fp_hi, fp_lo = fence(
+                "latch", progs["latch"](packed, choice))
+            rh, rl, rp, _n = fence(
+                "ring_probe", progs["ring_probe"](
+                    *self._ring_s, fp_hi, fp_lo, en, ovf))
+            self._ring_s = (rh, rl, rp)
+            return None
         if self.pipeline == "v4":
             lane_id, kvalid, kh, kl, krows = fence(
                 "front", progs["front"](rows, valid))
@@ -465,8 +608,15 @@ class ChunkProfiler:
             # timings from here on measure a pathologically full probe,
             # not the engine's.  Surfaced as a counter, never fatal.
             mt.counter("chunk_stage/insert_fail")
-        self._seen_total, self._qnext, _n = fence("total", progs[
-            "total"](rows, valid, self._seen_total, self._qnext))
+        if self.pipeline == "swarm":
+            rh, rl, rp, _n = fence("total", progs["total"](
+                rows, valid, *self._ring_t,
+                jnp.int32(self._swarm_k)))
+            self._ring_t = (rh, rl, rp)
+            self._swarm_k += 1
+        else:
+            self._seen_total, self._qnext, _n = fence("total", progs[
+                "total"](rows, valid, self._seen_total, self._qnext))
 
         self.samples += 1
         for s in self.stages:

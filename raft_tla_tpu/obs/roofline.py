@@ -354,13 +354,17 @@ def jaxpr_traffic(closed, arg_avals) -> dict:
 
 def stage_traffic(dims, B: int, K: int, *, pipeline: str = "v1",
                   compact_method: str = "scatter", v3_force=None,
-                  seen_capacity: int = 1 << 14) -> Dict[str, dict]:
+                  seen_capacity: int = 1 << 14, ring: int = 16,
+                  swarm_pipeline: str = "v1") -> Dict[str, dict]:
     """{stage: traffic dict} for the ChunkProfiler's stage programs —
     v1 granularity (expand/fingerprint/dedup_insert/enqueue), the v3
-    fused-stage granularity, or the v4 megakernel granularity
-    (front/insert_enqueue), matching ``chunk_stages`` keys so measured
-    means and modeled floors join by name.  Trace-only (eval_shape
-    chains the stage signatures); nothing executes or compiles.
+    fused-stage granularity, the v4 megakernel granularity
+    (front/insert_enqueue), or the swarm walk-kernel granularity
+    (expand/choose/latch/ring_probe; ``ring``/``swarm_pipeline``
+    mirror the swarm engine's dedup capacity and resolved expand
+    pipeline) — matching ``chunk_stages`` keys so measured means and
+    modeled floors join by name.  Trace-only (eval_shape chains the
+    stage signatures); nothing executes or compiles.
 
     ``seen_capacity`` shapes the probe table aval; it never enters the
     byte model (the insert touches probe WINDOWS, counted per round) —
@@ -371,7 +375,10 @@ def stage_traffic(dims, B: int, K: int, *, pipeline: str = "v1",
     from . import profile as profile_mod
     from ..ops import fpset
 
-    if pipeline == "v3":
+    if pipeline == "swarm":
+        progs = profile_mod.build_stage_programs_swarm(
+            dims, B, ring, pipeline=swarm_pipeline)
+    elif pipeline == "v3":
         progs = profile_mod.build_stage_programs_v3(
             dims, B, K, compact_method, force=v3_force)
     elif pipeline == "v4":
@@ -391,9 +398,25 @@ def stage_traffic(dims, B: int, K: int, *, pipeline: str = "v1",
     sw = state_width(dims)
     rows = jax.ShapeDtypeStruct((B, sw), jnp.uint8)
     valid = jax.ShapeDtypeStruct((B,), jnp.bool_)
+    out: Dict[str, dict] = {}
+    if pipeline == "swarm":
+        k = jax.ShapeDtypeStruct((), jnp.int32)
+        rh = jax.ShapeDtypeStruct((B, ring), jnp.uint32)
+        rp = jax.ShapeDtypeStruct((B,), jnp.int32)
+        packed, en, ovf = jax.eval_shape(progs["expand"], rows, valid)
+        out["expand"] = traced(progs["expand"], rows, valid)
+        choice = jax.eval_shape(progs["choose"], en, k)
+        out["choose"] = traced(progs["choose"], en, k)
+        _nrows, fp_hi, fp_lo = jax.eval_shape(progs["latch"], packed,
+                                              choice)
+        out["latch"] = traced(progs["latch"], packed, choice)
+        out["ring_probe"] = traced(progs["ring_probe"], rh, rh, rp,
+                                   fp_hi, fp_lo, en, ovf)
+        for t in out.values():
+            t["bytes_total"] = t["bytes_read"] + t["bytes_written"]
+        return out
     seen = jax.eval_shape(lambda: fpset.empty(seen_capacity))
     qnext = jax.ShapeDtypeStruct((progs["queue_rows"], sw), jnp.uint8)
-    out: Dict[str, dict] = {}
     if pipeline == "v4":
         lane_id, kvalid, kh, kl, krows = jax.eval_shape(
             progs["front"], rows, valid)

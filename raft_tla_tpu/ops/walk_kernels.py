@@ -142,3 +142,51 @@ def ring_reset(ring_hi, ring_lo, pos, mask):
     ring_hi = jnp.where(mask[:, None], SENTINEL, ring_hi)
     ring_lo = jnp.where(mask[:, None], SENTINEL, ring_lo)
     return ring_hi, ring_lo, jnp.where(mask, 0, pos)
+
+
+# -- observational Bloom filters (the hunt observatory) -----------------
+# The saturation estimator (obs/hunt.py) needs to classify every
+# accepted visit as the first / second / later observation of its
+# fingerprint WITHOUT reintroducing the global seen-set the swarm
+# exists to avoid.  A pair of fixed-size two-probe Bloom filters
+# (seen>=1 / seen>=2) gives that: O(1) gathers per step, scatter-max
+# updates (idempotent, so duplicate probes within one dispatch are
+# harmless), and — critically — the filters feed NOTHING back into the
+# walk decisions, so the hunt's verdict and fingerprint multiset stay
+# bit-identical with the observatory off (tests/test_swarm.py pins it).
+# Cells are uint8 (jnp scatter-max has no bitwise dtype), so a filter
+# is cells bytes of device memory; the default 2^20 keeps the two-probe
+# collision probability ~load^2 auditable in the hunt report.
+
+def bloom_init(cells: int):
+    """One empty filter: ``cells`` uint8 slots, ``cells`` a power of
+    two (the probes mask with ``cells - 1``)."""
+    if cells & (cells - 1) or cells < 2:
+        raise ValueError(f"bloom cells must be a power of two, "
+                         f"got {cells}")
+    return jnp.zeros((cells,), jnp.uint8)
+
+
+def bloom_probes(bloom, hi, lo):
+    """The two probe indices for fingerprint (hi, lo): the halves are
+    already independent avalanche mixes (ops/fingerprint.py), so their
+    low bits are the two hash functions for free."""
+    m = _U32(bloom.shape[0] - 1)
+    return (hi & m).astype(_I32), (lo & m).astype(_I32)
+
+
+def bloom_probe(bloom, hi, lo):
+    """Per-lane membership: True iff BOTH probe cells are set (the
+    standard k=2 conjunction; false positives ~load^2, never false
+    negatives)."""
+    i1, i2 = bloom_probes(bloom, hi, lo)
+    return (bloom[i1] > 0) & (bloom[i2] > 0)
+
+
+def bloom_push(bloom, hi, lo, do):
+    """Insert the lanes where ``do`` (scatter-max: racing duplicate
+    indices within one dispatch commute, so partition slicing cannot
+    change the resulting filter)."""
+    i1, i2 = bloom_probes(bloom, hi, lo)
+    m = do.astype(jnp.uint8)
+    return bloom.at[i1].max(m).at[i2].max(m)

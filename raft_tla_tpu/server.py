@@ -448,6 +448,12 @@ def _do_swarm(req, telemetry=None, _loaded=None):
     eng.events_out = tel.get("events_out")
     eng.postmortem_dir = tel.get("postmortem_dir")
     eng.run_context_extra = tel.get("run_context")
+    # Progress cadence is per-request (a watch-heavy client wants
+    # sub-second swarm_progress lines); reassigned every request so a
+    # cached engine never inherits the previous job's cadence.
+    eng.progress_seconds = (float(req["progress_seconds"])
+                            if req.get("progress_seconds") is not None
+                            else 5.0)
     seed = int(req.get("seed", 0))
     res = eng.run(initial_states(setup, seed=seed), seed=seed,
                   num_steps=(int(req["num_steps"])
@@ -468,8 +474,13 @@ def _do_swarm(req, telemetry=None, _loaded=None):
             from .obs.flight import host_fingerprint
             ctx = tel.get("run_context") or {}
             hfp = host_fingerprint()
+            hunt_sum = None
+            if res.report.get("hunt"):
+                from .obs import hunt as hunt_obs
+                hunt_sum = hunt_obs.summarize(res.report["hunt"])
             for kind, extra in (
-                    ("swarm", {"swarm": res.report.get("swarm")}),
+                    ("swarm", {"swarm": res.report.get("swarm"),
+                               "hunt": hunt_sum}),
                     ("server", {"job_id": ctx.get("job_id"),
                                 "tenant": ctx.get("tenant"),
                                 "mode": "swarm"})):
@@ -495,6 +506,7 @@ def _do_swarm(req, telemetry=None, _loaded=None):
            "pipeline": res.pipeline,
            "phases": {k: round(v, 4) for k, v in res.phases.items()},
            "report": dict(res.report),
+           "hunt": res.report.get("hunt"),
            "violation": None}
     if res.violation is not None:
         out["violation"] = _violation_json(eng, res.violation,
@@ -827,6 +839,7 @@ class _Handler(socketserver.StreamRequestHandler):
                 "level": RECORDER.last_event("level_complete"),
                 "coverage": RECORDER.last_event("coverage"),
                 "chunk_stage": RECORDER.last_record("chunk_stage"),
+                "hunt": RECORDER.last_record("hunt"),
             }
             if not self._try_respond({"ok": True, "watch": snapshot}):
                 return False
@@ -923,7 +936,8 @@ class _Handler(socketserver.StreamRequestHandler):
                         ("progress", RECORDER.last_record("progress")),
                         ("level",
                          RECORDER.last_event("level_complete")),
-                        ("coverage", RECORDER.last_event("coverage"))):
+                        ("coverage", RECORDER.last_event("coverage")),
+                        ("hunt", RECORDER.last_record("hunt"))):
                     if rec is not None and rec["seq"] > runrec["seq"]:
                         snapshot[key] = rec
             terminal = job["state"] in ("done", "failed", "cancelled")

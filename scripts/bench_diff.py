@@ -58,7 +58,11 @@ BENCH_r01–r05 files predate chunk_stages/coverage and still diff):
   visited/s, and the time-to-first-counterexample are gated; when the
   two sides speak DIFFERENT dialects, the diff folds to a note with
   both headlines reported and nothing gated — an exhaustive distinct/s
-  number and a swarm steps/s number measure different things.
+  number and a swarm steps/s number measure different things.  When
+  both sides also embed a hunt summary (obs/hunt.py), the coverage
+  saturation and per-bucket novelty trajectory are gated under
+  ``--hunt-drift``: a novelty curve that moved means the walks are
+  exploring differently, which is a semantics change, not a perf one.
 
 Additionally, when both runs embed a ``host_fingerprint`` (bench.py,
 BENCH_r06+), mismatched hardware/stack identity prints a loud
@@ -225,6 +229,58 @@ def diff_swarm(old: dict, new: dict, d: Diff, max_regress: float):
                   f"{(nv - ov) / ov * 100.0:.1f}% "
                   f"(> {max_regress:.0%} allowed): {ov:.2f}s -> "
                   f"{nv:.2f}s")
+
+
+def diff_hunt(old: dict, new: dict, d: Diff, drift: float):
+    """Hunt-observatory axes (both sides swarm with a ``hunt`` summary
+    — obs/hunt.py summarize): coverage saturation and the novelty rate
+    are reported, and the novelty CURVE is drift-gated — same seed and
+    budget should trace the same novelty trajectory, so any bucket of
+    the refolded curve moving more than ``--hunt-drift`` (absolute
+    novel-rate points) flags a behavioral change in the walk decisions
+    (diversification, ring, PRNG), not mere throughput noise.  A
+    saturation estimate falling more than the same drift regresses
+    too: the candidate's hunt is measurably further from done."""
+    oh, nh = old.get("hunt"), new.get("hunt")
+    if not isinstance(oh, dict) or not isinstance(nh, dict):
+        if isinstance(oh, dict) or isinstance(nh, dict):
+            d.note("hunt summary present on one side only "
+                   "(observatory toggled?) — hunt axes skipped")
+        return
+    for key, label, pct in (("saturation", "hunt saturation", True),
+                            ("novel_rate", "hunt novel rate", True),
+                            ("distinct_observed",
+                             "hunt distinct observed", False)):
+        ov, nv = oh.get(key), nh.get(key)
+        if ov is None or nv is None:
+            continue
+        if pct:
+            d.note(f"{label}: {ov:.1%} -> {nv:.1%}")
+        else:
+            d.note(f"{label}: {ov:,} -> {nv:,}")
+    ov, nv = oh.get("saturation"), nh.get("saturation")
+    if ov is not None and nv is not None and ov - nv > drift:
+        d.regress(f"hunt saturation fell {ov - nv:.2f} "
+                  f"(> {drift:g} allowed): {ov:.1%} -> {nv:.1%} — "
+                  f"the candidate's hunt is further from saturated "
+                  f"on the same budget")
+    oc = {int(k): r for k, r in (oh.get("novelty_curve") or [])}
+    nc = {int(k): r for k, r in (nh.get("novelty_curve") or [])}
+    worst = None
+    for k in sorted(set(oc) & set(nc)):
+        delta = abs(nc[k] - oc[k])
+        if worst is None or delta > worst[1]:
+            worst = (k, delta)
+        if delta > drift:
+            d.regress(f"novelty curve drift at step {k}: novel rate "
+                      f"{oc[k]:.1%} -> {nc[k]:.1%} (|delta| "
+                      f"{delta:.2f} > {drift:g} allowed) — the walks "
+                      f"are exploring differently, not just "
+                      f"slower/faster")
+    if worst is not None:
+        d.note(f"novelty curve: {len(set(oc) & set(nc))} comparable "
+               f"buckets, worst drift {worst[1]:.3f} at step "
+               f"{worst[0]}")
 
 
 def diff_phases(old: dict, new: dict, d: Diff, max_regress: float,
@@ -508,6 +564,14 @@ def main(argv=None) -> int:
                         "vs the baseline — a collapsed reduction fails "
                         "(default 1.0; only checked when either side "
                         "pruned anything)")
+    p.add_argument("--hunt-drift", type=float, default=0.25,
+                   help="(swarm) allowed absolute drift in each "
+                        "refolded novelty-curve bucket's novel rate "
+                        "and in the saturation estimate vs the "
+                        "baseline (default 0.25) — same seed and "
+                        "budget tracing a different novelty "
+                        "trajectory means the walk DECISIONS changed, "
+                        "not just the throughput")
     args = p.parse_args(argv)
 
     try:
@@ -557,10 +621,15 @@ def main(argv=None) -> int:
     diff_headline(old, new, d, args.max_regress)
     diff_phases(old, new, d, args.phase_max_regress, args.phase_floor)
     if om == "swarm":
-        # Swarm-dialect axes; the exhaustive stage/perf/coverage axes
-        # have no meaning for a walker (no chunk_stages, no coverage
-        # object) and fall through as silent no-ops anyway.
+        # Swarm-dialect axes, then the shared perf/stage axes (swarm
+        # docs now embed a perf block and walk-kernel chunk_stages —
+        # the launch-drift and stage gates apply unchanged); the
+        # exhaustive pruned/coverage axes have no meaning for a walker
+        # and fall through as silent no-ops anyway.
         diff_swarm(old, new, d, args.max_regress)
+        diff_hunt(old, new, d, args.hunt_drift)
+        diff_stages(old, new, d, args.stage_max_regress)
+        diff_perf(old, new, d, args.launch_drift)
         return d.render()
     diff_stages(old, new, d, args.stage_max_regress)
     diff_perf(old, new, d, args.launch_drift)

@@ -100,6 +100,13 @@ def main(argv=None) -> int:
                         "trajectory view of whether fusion work is "
                         "retiring launches across rounds; entries "
                         "predating the metric render '--'")
+    p.add_argument("--hunt", action="store_true",
+                   help="add the hunt-observatory columns (coverage "
+                        "saturation + novelty rate + time-to-violation "
+                        "from each swarm entry's hunt summary, "
+                        "obs/hunt.py) — the trajectory view of whether "
+                        "successive hunts are saturating sooner or "
+                        "latching faster; exhaustive rows render '--'")
     args = p.parse_args(argv)
 
     if args.import_legacy is not None:
@@ -117,7 +124,8 @@ def main(argv=None) -> int:
     except (OSError, ValueError) as e:
         print(f"bench_history: {e}", file=sys.stderr)
         return 2
-    print(history_mod.render_table(entries, perf=args.perf))
+    print(history_mod.render_table(entries, perf=args.perf,
+                                   hunt=args.hunt))
     return 0
 
 

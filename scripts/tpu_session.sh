@@ -169,6 +169,29 @@ else
     echo "skipped: tunnel dead"
 fi
 
+echo "== 2e. bench --mode swarm (hunt observatory, 60 s) =="
+# The second product tier's driver metric: swarm steps/s on real
+# hardware, with the perf accounting (launches/chunk) and the hunt
+# summary (saturation, novelty trajectory, time-to-violation) embedded
+# in the JSON — bench_diff gates later swarm rounds on BOTH rate and
+# hunt drift (--hunt-drift), and bench_history --hunt renders the
+# saturation trajectory.  Diffed against the v2 bench only to record
+# the cross-dialect fold note (distinct/s vs steps/s are different
+# headlines; nothing is gated across modes).
+if probe; then
+    BENCH_SECONDS=60 BENCH_MODE=swarm BENCH_ORACLE_SECONDS=1 \
+        timeout 900 python bench.py \
+        2> artifacts/bench_tpu_swarm.log \
+        | tee artifacts/bench_tpu_swarm.json \
+        || echo "bench swarm stage failed (rc=$?)"
+    python scripts/bench_diff.py artifacts/bench_tpu.json \
+        artifacts/bench_tpu_swarm.json \
+        | tee artifacts/bench_tpu_v2_vs_swarm.txt \
+        || echo "(cross-mode diff rc=$? — expected note-only fold)"
+else
+    echo "skipped: tunnel dead"
+fi
+
 echo "== 3. leader-rich bench (60 s) =="
 if probe; then
     timeout 900 python scripts/leader_bench.py 60 \
@@ -252,6 +275,23 @@ if probe; then
         | tee artifacts/xplane_v2_vs_v4.txt \
         || echo "xplane v2-vs-v4 launch diff: rc=$? (1 = launch "\
 "regression verdict, 2 = unreadable capture)"
+    # Swarm walk-chunk capture: the same device-truth treatment for the
+    # second tier — the scan-step launch pin (tests/test_perf.py
+    # SWARM_LAUNCH_PINS) is a jaxpr count; this is where it gets
+    # checked against what the hardware actually scheduled.
+    timeout 600 python -m raft_tla_tpu check \
+        configs/MCraft_bounded.cfg ${PLAT_ARGS} --mode swarm \
+        --walks 1024 --max-depth 16 --max-seconds 60 \
+        --xla-profile 16 \
+        --xla-profile-dir artifacts/xla_profile_swarm \
+        2> artifacts/xla_profile_swarm.log \
+        | tee artifacts/xla_profile_swarm.txt \
+        || echo "xla-profile swarm stage failed (rc=$?)"
+    python scripts/xplane_summary.py artifacts/xla_profile_swarm \
+        --out artifacts/xplane_summary_swarm.json \
+        --history artifacts/history.jsonl \
+        --label "xplane_swarm" \
+        || echo "xplane summary swarm failed (rc=$?)"
 else
     echo "skipped: tunnel dead"
 fi

@@ -174,6 +174,46 @@ def test_launch_counts_pinned_per_pipeline(pipe):
         f"the delta explained; otherwise a stage just un-fused.")
 
 
+#: Swarm chunk program on the same tiny model (walks=batch=32,
+#: depth=12, ring=8, chunk=8, TypeOK+NoLeader, hunt_cells=2^16).  Keyed
+#: by the hunt flag: the +147-op delta IS the observatory's whole
+#: static footprint (bloom probes/pushes + the O(B^2) same-fingerprint
+#: prior + depth/family tallies), pinned so analytics creep into the
+#: walk hot loop fails CI the same way an un-fused stage does.  Only 3
+#: fixed ops (vs the BFS engines' 6): the swarm scaffolding is the
+#: scan wrapper alone — no queue/frontier plumbing.
+SWARM_LAUNCH_PINS = {
+    False: {"launches_per_batch": 3104, "launches_fixed": 3},
+    True: {"launches_per_batch": 3251, "launches_fixed": 3},
+}
+
+
+@pytest.mark.parametrize("hunt", [False, True])
+def test_swarm_launch_counts_pinned(hunt):
+    from raft_tla_tpu.engine.swarm import SwarmEngine
+    from raft_tla_tpu.models.dims import LEADER
+    from raft_tla_tpu.models.invariants import build_type_ok
+    eng = SwarmEngine(
+        DIMS,
+        invariants={"TypeOK": build_type_ok(DIMS),
+                    "NoLeader": lambda st: jnp.all(st.role != LEADER)},
+        constraint=build_constraint(DIMS, BOUNDS),
+        walks=32, max_depth=12, batch=32, chunk=8, ring=8,
+        hunt=hunt, hunt_cells=1 << 16, perf=True)
+    lm = eng._perf.launch_model
+    assert lm is not None, "swarm launch model failed to build"
+    got = {k: lm[k] for k in ("launches_per_batch", "launches_fixed")}
+    assert got == SWARM_LAUNCH_PINS[hunt], (
+        f"swarm chunk-program launch count moved (hunt={hunt}): {got} "
+        f"!= pinned {SWARM_LAUNCH_PINS[hunt]}.  If the walk body or "
+        f"hunt tallies changed intentionally, re-pin WITH the delta "
+        f"explained; otherwise the walk loop just grew device ops.")
+    # The observatory's footprint is bounded: hunt adds device ops to
+    # the scan body but never an order of magnitude.
+    assert SWARM_LAUNCH_PINS[True]["launches_per_batch"] \
+        <= 1.10 * SWARM_LAUNCH_PINS[False]["launches_per_batch"]
+
+
 def test_v3_fused_tail_retires_launches():
     """The relation (not just the absolute pins): v3's fused tail must
     count FEWER device ops than v2's split insert+enqueue — the

@@ -784,6 +784,57 @@ def test_watch_job_sees_own_progress(jobsrv):
     assert not bad["ok"] and "unknown job" in bad["error"]
 
 
+def test_watch_swarm_job_streams_progress_and_hunt(jobsrv):
+    """ISSUE 20 satellite regression: a watch attached to a SWARM job
+    streams that job's swarm_progress + hunt flight records with job
+    attribution — records newer than the job-tagged run_context
+    (seq-ordered), never a stale line from a previous run."""
+    from raft_tla_tpu.obs.flight import RECORDER
+    srv, _hist = jobsrv
+    addr = srv.server_address
+    cfg = os.path.join(REPO, "configs/MCraft_noleader.cfg")
+    seq0 = RECORDER.seq()
+    r = roundtrip(addr, {"op": "submit", "tenant": "t1",
+                         "job": {"op": "check", "cfg": cfg,
+                                 "mode": "swarm", "walks": 64,
+                                 "max_depth": 12, "num_steps": 512,
+                                 "seed": 5, "batch": 32,
+                                 "progress_seconds": 0.2}})
+    assert r["ok"], r
+    jid = r["job"]["id"]
+    got = []
+    with socket.create_connection(addr, timeout=600) as s:
+        s.sendall((json.dumps({"op": "watch", "job": jid,
+                               "interval": 0.05}) + "\n").encode())
+        s.settimeout(600)
+        for line in s.makefile("rb"):
+            rec = json.loads(line)
+            got.append(rec)
+            if rec.get("done"):
+                break
+    assert got[-1].get("done") and got[-1]["job"]["state"] == "done"
+    snaps = [g["watch"] for g in got if "watch" in g]
+    assert all(s["job"]["id"] == jid for s in snaps)
+    tagged = [s for s in snaps if s.get("run")]
+    assert tagged, "watch never saw the swarm job's armed run"
+    assert all(s["run"]["job_id"] == jid for s in tagged)
+    # Swarm progress lines, attributed and fresh (seq > submit point).
+    prog = [s["progress"] for s in snaps
+            if s.get("progress") and s["progress"]["seq"] > seq0]
+    assert prog, "watch never saw the swarm job's progress lines"
+    assert all(p["mode"] == "swarm" for p in prog)
+    assert prog[-1]["steps"] > 0
+    # Hunt snapshots ride the same stream with the same attribution.
+    hunts = [s["hunt"] for s in snaps
+             if s.get("hunt") and s["hunt"]["seq"] > seq0]
+    assert hunts, "watch never saw the swarm job's hunt snapshots"
+    assert all(0.0 <= h["saturation"] <= 1.0 for h in hunts)
+    assert hunts[-1]["observations"] > 0
+    # The job's result carries the full hunt report.
+    res = roundtrip(addr, {"op": "result", "job_id": jid})
+    assert res["ok"] and isinstance(res["result"]["hunt"], dict)
+
+
 def test_watch_outlives_idle_timeout_while_job_queued():
     """ISSUE 13 satellite regression: a watcher attached to a QUEUED
     job must not be reaped while the job is alive — neither by the

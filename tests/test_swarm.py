@@ -260,6 +260,10 @@ def test_server_swarm_check_and_mode_directive(server):
     assert r["ok"] is True and r["mode"] == "swarm"
     assert r["walks"] == 32 and r["steps"] == 32 * 16
     assert isinstance(r["report"]["swarm"], dict)
+    # The hunt report rides the response top-level (ISSUE 20).
+    assert isinstance(r["hunt"], dict)
+    assert 0.0 <= r["hunt"]["saturation"] <= 1.0
+    assert r["hunt"]["observations"] > 0
     # The cfg MODE/WALKS directives drive the same path when the
     # request leaves mode unset.
     with open(cfg) as f:
@@ -269,6 +273,141 @@ def test_server_swarm_check_and_mode_directive(server):
                             "max_depth": 8, "num_steps": 16, "seed": 5})
     assert r2["ok"] is True and r2["mode"] == "swarm"
     assert r2["walks"] == 16
+
+
+# ---------------------------------------------------------------------------
+# Hunt observatory (obs/hunt.py): coverage estimation + walk analytics.
+
+def test_hunt_is_purely_observational():
+    """ISSUE 20 acceptance: the observatory can never perturb the hunt
+    — verdict and visited-fingerprint multiset are bit-identical with
+    hunt on vs off (the off engine builds a bare chunk with no bloom
+    args at all, so this pins the whole analytics block out of the
+    walk semantics)."""
+    _e, ron, a = run_swarm(hunt=True)
+    _e, roff, b = run_swarm(hunt=False)
+    assert np.array_equal(a, b)
+    assert ron.stop_reason == roff.stop_reason
+    assert ron.visited == roff.visited and ron.steps == roff.steps
+    assert ron.traces == roff.traces and ron.diameter == roff.diameter
+    assert "hunt" in ron.report and "hunt" not in roff.report
+
+
+def _hunt_run(num_steps):
+    """TypeOK-only invariant set: no reachable violation, so the budget
+    runs to completion at every size (the honesty pin needs growing
+    samples, not a latch race)."""
+    eng = SwarmEngine(DIMS, invariants={"TypeOK": build_type_ok(DIMS)},
+                     constraint=build_constraint(DIMS, BOUNDS),
+                     walks=48, max_depth=12, chunk=8, ring=8,
+                     collect_fingerprints=True)
+    return eng, eng.run([safe_root()], seed=5, num_steps=num_steps)
+
+
+def _species_counts(fps):
+    key = (fps[:, 0].astype(np.uint64) << np.uint64(32)
+           | fps[:, 1].astype(np.uint64))
+    uniq, counts = np.unique(key, return_counts=True)
+    return len(key), len(uniq), int((counts == 1).sum())
+
+
+def test_hunt_estimator_is_honest_against_exact_recount():
+    """Estimator honesty: the device Bloom tallies must reproduce the
+    exact species counts recomputed on host from the full collected
+    fingerprint multiset (the oracle for this run), within the pinned
+    collision tolerance — and the saturation estimate must grow toward
+    1 as the walk budget grows."""
+    sats, distincts = [], []
+    for num_steps in (8, 64, 512):
+        _eng, res = _hunt_run(num_steps)
+        h = res.report["hunt"]
+        n, distinct, n1 = _species_counts(res.visited_fingerprints)
+        # The observation stream IS the accepted-visit multiset.
+        assert h["observations"] == n
+        # Oracle recount: distinct species, singletons, saturation.
+        # Tolerances pin the only permitted error source — two-probe
+        # Bloom collisions — at these loads (~1k species in 2^20
+        # cells) they are near zero.
+        assert abs(h["distinct_observed"] - distinct) \
+            <= max(2, 0.01 * distinct)
+        assert abs(h["singletons"] - n1) <= max(2, 0.02 * n1)
+        sat_exact = 1.0 - (n1 / n if n else 1.0)
+        assert abs(h["saturation"] - sat_exact) <= 0.01
+        sats.append(h["saturation"])
+        distincts.append(h["distinct_observed"])
+    assert sats == sorted(sats)                 # never regresses
+    assert sats[-1] > sats[0] + 0.01            # and genuinely grows
+    assert distincts[-1] > distincts[0]
+
+
+def test_hunt_report_schema_and_partitions():
+    from raft_tla_tpu.obs.hunt import RESTART_REASONS
+    eng, res, _fps = run_swarm()
+    h = res.report["hunt"]
+    # Good-Turing identities.
+    assert abs(h["saturation"] + h["unseen_mass"] - 1.0) <= 2e-6
+    assert (h["singletons"] + h["doubletons_plus"]
+            == h["distinct_observed"])
+    assert 0 < h["distinct_observed"] <= h["observations"]
+    assert h["steps"] == res.steps
+    # Restart census partitions cleanly and every completed trace is
+    # one restart (walks still in flight at budget end are not traces).
+    r = h["restarts"]
+    assert r["total"] == sum(r[k] for k in RESTART_REASONS)
+    d = h["depth"]
+    assert sum(d["histogram"]) == d["traces"] == r["total"]
+    assert len(d["histogram"]) == eng.max_depth + 1
+    assert 0 <= d["p50"] <= d["p90"] <= eng.max_depth
+    # Family efficacy table: canonical names, nested tallies, and the
+    # Holzmann diversification visibly spreading the hunt.
+    fams = h["families"]
+    assert [f["family"] for f in fams] == list(DIMS.family_names)
+    for f in fams:
+        assert 0 <= f["fresh"] <= f["accepted"] <= f["chosen"]
+    assert sum(1 for f in fams if f["fresh"]) >= 2
+    # Estimator-health block: filter geometry + audited collision bias.
+    b = h["bloom"]
+    assert b["cells"] == eng.hunt_cells
+    assert 0.0 < b["load"] <= 1.0
+    assert b["collision_probability"] == round(b["load"] ** 2, 8)
+    # Novelty curve: bounded, rates in [0, 1], step axis monotone.
+    curve = h["novelty_curve"]
+    assert 0 < len(curve) <= 2048
+    assert all(0.0 <= p[1] <= 1.0 for p in curve)
+    assert [p[0] for p in curve] == sorted(p[0] for p in curve)
+    assert h["time_to_violation_seconds"] is None
+    assert h["wall_seconds"] > 0
+
+
+def test_hunt_event_and_progress_embed_the_report(violation_run):
+    """The ``hunt`` run event validates with its registered payload
+    object, agrees with ``SwarmResult.report["hunt"]``, and the
+    enriched ``swarm_progress``/``run_end`` swarm blocks carry the live
+    snapshot; a violating hunt stamps time-to-violation."""
+    _eng, res, _tmp, ev = violation_run
+    h = res.report["hunt"]
+    assert h["time_to_violation_seconds"] == res.violation_at_seconds
+    events = validate_run_events(ev)
+    hunts = [e for e in events if e["event"] == "hunt"]
+    assert len(hunts) == 1
+    assert hunts[0]["hunt"]["saturation"] == h["saturation"]
+    assert hunts[0]["hunt"]["observations"] == h["observations"]
+    prog = next(e for e in events if e["event"] == "swarm_progress")
+    assert 0.0 <= prog["swarm"]["hunt"]["saturation"] <= 1.0
+    end = events[-1]
+    assert end["event"] == "run_end"
+    assert end["swarm"]["hunt"]["distinct_observed"] \
+        == h["distinct_observed"]
+
+
+def test_hunt_event_without_payload_object_is_rejected(tmp_path):
+    p = tmp_path / "ev.jsonl"
+    lines = [{"event": "run_start", "ts": 0.0},
+             {"event": "hunt", "ts": 1.0, "hunt": "saturated"},
+             {"event": "run_end", "ts": 2.0}]
+    p.write_text("".join(json.dumps(e) + "\n" for e in lines))
+    with pytest.raises(ValueError, match="hunt"):
+        validate_run_events(str(p))
 
 
 def test_server_rejects_unknown_mode_cleanly(server):
